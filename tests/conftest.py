@@ -1,0 +1,41 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX initializes.
+
+This is the JAX analog of TF's in-process multi-worker fakes (SURVEY.md §4):
+``--xla_force_host_platform_device_count=8`` gives every test a deterministic
+8-device mesh on CPU, so single-host "MirroredStrategy-equivalent" and sharding
+behavior is exercised without TPU hardware. Multi-process behavior is covered
+separately by the loopback-process harness (tests/test_multiprocess.py, added
+with the trainer layer).
+
+Environment wrinkle: this image's ``sitecustomize.py`` imports jax and
+registers a TPU PJRT plugin at interpreter start — before any conftest runs —
+so ``JAX_PLATFORMS`` set here via os.environ is too late (jax read it at
+import). The backend itself initializes lazily, so updating ``jax.config``
+before the first device query still wins; XLA_FLAGS is read at backend init so
+the env var is still effective for the virtual device count.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devices = jax.devices()
+    assert len(devices) == 8, (
+        "expected 8 virtual CPU devices; platform override failed "
+        f"(got {len(devices)}: {devices})"
+    )
+    return devices

@@ -1,0 +1,140 @@
+"""Unit tests for TF_CONFIG parsing (SURVEY.md §4 test plan, item 1).
+
+Covers the contract of reference README.md:36-59 + tf_dist_example.py:6-10:
+cluster map roles, task identity, chief resolution, malformed-config errors.
+"""
+
+import json
+
+import pytest
+
+from tpu_dist.cluster import (
+    ClusterConfig,
+    ClusterConfigError,
+    ClusterSpec,
+    make_local_cluster,
+)
+
+# The exact TF_CONFIG the reference example builds (tf_dist_example.py:6-10).
+REFERENCE_TF_CONFIG = {
+    "cluster": {"worker": ["172.16.16.5:12345", "172.16.16.6:12345"]},
+    "task": {"type": "worker", "index": 1},
+}
+
+
+class TestParsing:
+    def test_reference_example_config(self):
+        cfg = ClusterConfig.from_json(json.dumps(REFERENCE_TF_CONFIG))
+        assert cfg.num_processes == 2
+        assert cfg.task.type == "worker"
+        assert cfg.task.index == 1
+        assert cfg.process_id == 1
+        assert not cfg.is_chief  # worker 0 is the default chief (README.md:51)
+        assert cfg.coordinator_address == "172.16.16.5:12345"
+        assert cfg.task_address == "172.16.16.6:12345"
+
+    def test_accepts_dict_payload(self):
+        cfg = ClusterConfig.from_json(REFERENCE_TF_CONFIG)
+        assert cfg.num_processes == 2
+
+    def test_worker_zero_is_chief_by_default(self):
+        cfg = ClusterConfig.from_json(
+            {"cluster": {"worker": ["a:1", "b:2"]},
+             "task": {"type": "worker", "index": 0}})
+        assert cfg.is_chief
+
+    def test_explicit_chief_role(self):
+        # README.md:44-51: chief is a worker with extra duties; when declared,
+        # it outranks worker 0.
+        payload = {
+            "cluster": {"chief": ["c:1"], "worker": ["a:1", "b:2"]},
+            "task": {"type": "worker", "index": 0},
+        }
+        cfg = ClusterConfig.from_json(payload)
+        assert not cfg.is_chief
+        chief = ClusterConfig.from_json(
+            {**payload, "task": {"type": "chief", "index": 0}})
+        assert chief.is_chief
+        # Chief gets global process id 0; workers follow.
+        assert chief.process_id == 0
+        assert cfg.process_id == 1
+        assert chief.coordinator_address == "c:1"
+
+    def test_all_four_reference_roles(self):
+        # README.md:44-57 documents chief/worker/ps/evaluator.
+        payload = {
+            "cluster": {
+                "chief": ["c:1"],
+                "worker": ["w0:1", "w1:1"],
+                "ps": ["p0:1"],
+                "evaluator": ["e0:1"],
+            },
+            "task": {"type": "evaluator", "index": 0},
+        }
+        cfg = ClusterConfig.from_json(payload)
+        assert cfg.num_processes == 5
+        # Canonical order: chief, worker, ps, evaluator.
+        assert cfg.process_id == 4
+        assert cfg.cluster.roles == ("chief", "worker", "ps", "evaluator")
+
+    def test_env_parsing_and_absence(self, monkeypatch):
+        monkeypatch.delenv("TF_CONFIG", raising=False)
+        assert ClusterConfig.from_env() is None
+        monkeypatch.setenv("TF_CONFIG", "")
+        assert ClusterConfig.from_env() is None
+        monkeypatch.setenv("TF_CONFIG", json.dumps(REFERENCE_TF_CONFIG))
+        cfg = ClusterConfig.from_env()
+        assert cfg is not None and cfg.num_processes == 2
+
+
+class TestValidation:
+    def test_task_must_match_cluster_entry(self):
+        # README.md:59: task must name an entry of the cluster map.
+        with pytest.raises(ClusterConfigError):
+            ClusterConfig.from_json(
+                {"cluster": {"worker": ["a:1"]},
+                 "task": {"type": "worker", "index": 1}})
+        with pytest.raises(ClusterConfigError):
+            ClusterConfig.from_json(
+                {"cluster": {"worker": ["a:1"]},
+                 "task": {"type": "ps", "index": 0}})
+
+    def test_invalid_json(self):
+        with pytest.raises(ClusterConfigError):
+            ClusterConfig.from_json("{not json")
+
+    def test_missing_keys(self):
+        with pytest.raises(ClusterConfigError):
+            ClusterConfig.from_json({"cluster": {"worker": ["a:1"]}})
+        with pytest.raises(ClusterConfigError):
+            ClusterConfig.from_json({"task": {"type": "worker", "index": 0}})
+        with pytest.raises(ClusterConfigError):
+            ClusterConfig.from_json(
+                {"cluster": {"worker": ["a:1"]}, "task": {"type": "worker"}})
+
+    def test_malformed_addresses(self):
+        with pytest.raises(ClusterConfigError):
+            ClusterSpec(jobs={"worker": ["no-port"]})
+        with pytest.raises(ClusterConfigError):
+            ClusterSpec(jobs={"worker": "host:1"})  # bare string, not a list
+
+    def test_negative_index(self):
+        with pytest.raises(ClusterConfigError):
+            ClusterConfig.from_json(
+                {"cluster": {"worker": ["a:1"]},
+                 "task": {"type": "worker", "index": -1}})
+
+
+class TestLocalClusterFabrication:
+    def test_make_local_cluster(self):
+        configs = make_local_cluster(3, base_port=4000)
+        assert len(configs) == 3
+        parsed = [ClusterConfig.from_json(c) for c in configs]
+        assert [p.process_id for p in parsed] == [0, 1, 2]
+        assert parsed[0].is_chief and not parsed[1].is_chief
+        # Identical cluster map on every node (README.md:59).
+        assert len({json.dumps(c["cluster"]) for c in configs}) == 1
+
+    def test_rejects_zero_workers(self):
+        with pytest.raises(ClusterConfigError):
+            make_local_cluster(0)

@@ -1,0 +1,35 @@
+"""Cluster/config layer: TF_CONFIG-shaped config + JAX coordination bring-up."""
+
+from tpu_dist.cluster.config import (
+    TF_CONFIG_ENV,
+    ClusterConfig,
+    ClusterConfigError,
+    ClusterSpec,
+    TaskInfo,
+    make_local_cluster,
+)
+from tpu_dist.cluster.bootstrap import (
+    barrier,
+    cluster_config,
+    initialize,
+    is_chief,
+    is_initialized,
+    process_count,
+    process_index,
+)
+
+__all__ = [
+    "TF_CONFIG_ENV",
+    "ClusterConfig",
+    "ClusterConfigError",
+    "ClusterSpec",
+    "TaskInfo",
+    "make_local_cluster",
+    "barrier",
+    "cluster_config",
+    "initialize",
+    "is_chief",
+    "is_initialized",
+    "process_count",
+    "process_index",
+]

@@ -1,0 +1,53 @@
+"""Parallelism layer: device mesh, collectives, distribution strategies."""
+
+from tpu_dist.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharded,
+    make_mesh,
+    replicate,
+    replicated,
+    shard_batch,
+)
+from tpu_dist.parallel.collectives import (
+    CollectiveCommunication,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    broadcast_from_chief,
+    host_all_reduce_sum,
+    set_collective_logging,
+)
+from tpu_dist.parallel.strategy import (
+    DefaultStrategy,
+    MirroredStrategy,
+    MultiWorkerMirroredStrategy,
+    ParameterServerStrategy,
+    Strategy,
+    get_strategy,
+    has_strategy,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharded",
+    "make_mesh",
+    "replicate",
+    "replicated",
+    "shard_batch",
+    "CollectiveCommunication",
+    "ReduceOp",
+    "all_gather",
+    "all_reduce",
+    "broadcast_from_chief",
+    "host_all_reduce_sum",
+    "set_collective_logging",
+    "DefaultStrategy",
+    "MirroredStrategy",
+    "MultiWorkerMirroredStrategy",
+    "ParameterServerStrategy",
+    "Strategy",
+    "get_strategy",
+    "has_strategy",
+]

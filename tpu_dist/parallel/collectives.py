@@ -1,0 +1,153 @@
+"""Thin collectives layer: communication modes, reduce wrappers, shape logging.
+
+The reference's entire collective stack (SURVEY.md §5.8) — RING-over-gRPC and
+NCCL transports, group/instance keys, tensor packing, launcher threads,
+MEAN = SUM / group_size (tf:...cross_device_ops.py:1045-1234,
+cross_device_utils.py:347-420) — collapses on TPU into XLA-compiled
+``psum/pmean`` over mesh axes: the compiler emits CrossReplicaSum over ICI
+(intra-slice) / DCN (inter-slice) and does its own bucketing and
+compute/communication overlap. What legitimately survives as framework code:
+
+* the communication-mode enum, accepted for reference compatibility
+  (``CollectiveCommunication.{AUTO,RING,NCCL}``, tf_dist_example.py:12,
+  README.md:23) plus the TPU-native modes it maps onto;
+* reduce wrappers with *collective-shape debug logging*, mirroring the
+  reference's per-step "Collective all_reduce tensors: N all_reduces,
+  group_size = G" INFO lines (tf:...cross_device_ops.py:1153-1158) that the
+  survey used to verify sync behavior (SURVEY.md §3.5, §5.5);
+* host-side scalar reductions over the coordination service for out-of-step
+  values (metric summaries, early-stop votes).
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+logger = logging.getLogger("tpu_dist.collectives")
+
+#: Flip with `set_collective_logging` — mirrors TF's INFO logging of every
+#: batched all-reduce shape.
+_LOG_COLLECTIVES = False
+
+
+def set_collective_logging(enabled: bool) -> None:
+    global _LOG_COLLECTIVES
+    _LOG_COLLECTIVES = bool(enabled)
+
+
+class CollectiveCommunication(enum.Enum):
+    """Communication-implementation hint.
+
+    ``AUTO``/``RING``/``NCCL`` are the reference's enum values
+    (tf:python/distribute/collective_util.py:28-47; README.md:23: AUTO picks by
+    hardware/topology/tensor size). On TPU there is no user-selectable
+    transport — XLA emits ICI collectives intra-slice and DCN collectives
+    across slices — so RING and NCCL are accepted and mapped to AUTO with a
+    log note, and ICI/DCN exist to make the TPU fabric choice explicit in
+    diagnostics.
+    """
+
+    AUTO = "AUTO"
+    RING = "RING"
+    NCCL = "NCCL"
+    ICI = "ICI"
+    DCN = "DCN"
+
+    @classmethod
+    def resolve(cls, value: "CollectiveCommunication | str | None"):
+        if value is None:
+            return cls.AUTO
+        if isinstance(value, str):
+            try:
+                value = cls[value.upper()]
+            except KeyError:
+                raise ValueError(
+                    f"unknown CollectiveCommunication {value!r}; valid: "
+                    f"{[m.name for m in cls]}") from None
+        if value in (cls.RING, cls.NCCL):
+            logger.info(
+                "CollectiveCommunication.%s has no effect on TPU; XLA emits "
+                "ICI/DCN collectives (treating as AUTO)", value.name)
+        return value
+
+
+class ReduceOp(enum.Enum):
+    """Cross-replica reduction op (TF ``tf.distribute.ReduceOp`` analog).
+
+    MEAN is implemented as SUM / group_size exactly as the reference does
+    (tf:...cross_device_ops.py:1170-1180)."""
+
+    SUM = "sum"
+    MEAN = "mean"
+    MAX = "max"
+    MIN = "min"
+
+
+def _log_tree(op: str, tree: Any, axis: str) -> None:
+    if not _LOG_COLLECTIVES:
+        return
+    leaves = jax.tree_util.tree_leaves(tree)
+    # Group size is the mesh-axis extent; available inside tracing via
+    # axis size.
+    try:
+        group = jax.lax.axis_size(axis)
+    except Exception:
+        group = "?"
+    logger.info(
+        "Collective %s tensors: %d all_reduces, group_size = %s, shapes = %s",
+        op, len(leaves), group, [tuple(l.shape) for l in leaves])
+
+
+def all_reduce(tree: Any, axis: str, op: ReduceOp | str = ReduceOp.MEAN) -> Any:
+    """Reduce a pytree across a mesh axis, inside a jitted/shard_map context.
+
+    The one-call replacement for the reference's gradient all-reduce pipeline
+    (grad packing + CollectiveReduceV2 launch, SURVEY.md D5-D7). XLA fuses and
+    schedules the emitted CrossReplicaSum ops; no manual packing needed.
+    """
+    op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
+    _log_tree(f"all_reduce[{op.value}]", tree, axis)
+    if op is ReduceOp.SUM:
+        return jax.lax.psum(tree, axis)
+    if op is ReduceOp.MEAN:
+        return jax.lax.pmean(tree, axis)
+    if op is ReduceOp.MAX:
+        return jax.tree_util.tree_map(lambda x: jax.lax.pmax(x, axis), tree)
+    if op is ReduceOp.MIN:
+        return jax.tree_util.tree_map(lambda x: jax.lax.pmin(x, axis), tree)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def all_gather(x: Any, axis: str, *, tiled: bool = False) -> Any:
+    """Gather values across a mesh axis (per-replica -> global view)."""
+    _log_tree("all_gather", x, axis)
+    return jax.lax.all_gather(x, axis, tiled=tiled)
+
+
+def host_all_reduce_sum(x) -> Any:
+    """Host-level scalar/array SUM across processes, outside any jitted step.
+
+    Uses a tiny compiled psum over the global device set (rides the same ICI/
+    DCN fabric); the analog of the reference's host-side PerReplica metric
+    reduction (keras trainer reduce_per_replica, SURVEY.md D15).
+    """
+    if jax.process_count() == 1:
+        return x
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(jnp.asarray(x)).sum(axis=0)
+
+
+def broadcast_from_chief(tree: Any) -> Any:
+    """Broadcast process 0's pytree to all processes (host-level, D4 init
+    broadcast / checkpoint-restore fan-out)."""
+    if jax.process_count() == 1:
+        return tree
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(tree)

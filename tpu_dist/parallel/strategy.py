@@ -1,0 +1,231 @@
+"""Distribution-strategy front-ends: the reference's user-facing API, TPU-native.
+
+Reproduces the strategy surface the reference exercises (SURVEY.md §2.1 R2,
+§2.3):
+
+* :class:`MirroredStrategy` — synchronous data parallelism across the devices
+  of one host (README.md:15-19; tf_dist_example.py:13).
+* :class:`MultiWorkerMirroredStrategy` — the same, across every process in the
+  cluster (README.md:21-29; tf_dist_example.py:12), with the reference's
+  degradation rule: no cluster / one worker behaves like MirroredStrategy
+  (README.md:34).
+* :class:`ParameterServerStrategy` is a documented non-goal: the reference
+  mentions async PS training only to recommend against it (README.md:5-7, 13)
+  and never runs it (SURVEY.md D19). Constructing it raises with that
+  explanation.
+
+Architecture shift (the heart of the TPU-native design): a TF strategy is an
+*object* that intercepts variable creation, owns cross-device ops and launches
+collectives at runtime. Here a strategy is a thin factory for a named
+``jax.sharding.Mesh`` plus sharding policy — "mirrored variables" are arrays
+with replicated sharding, and the gradient all-reduce is compiled into the
+train step by XLA's SPMD partitioner (SURVEY.md §5.8). ``scope()`` survives as
+ergonomics: it pins the active strategy so ``compile``/``fit`` pick it up,
+letting the reference script port line-for-line (tf_dist_example.py:56-59).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Sequence
+
+from tpu_dist.cluster import bootstrap
+from tpu_dist.parallel import mesh as mesh_lib
+from tpu_dist.parallel.collectives import CollectiveCommunication, ReduceOp
+
+logger = logging.getLogger("tpu_dist.strategy")
+
+_LOCAL = threading.local()
+
+
+def _strategy_stack() -> list:
+    if not hasattr(_LOCAL, "stack"):
+        _LOCAL.stack = []
+    return _LOCAL.stack
+
+
+class _Scope:
+    def __init__(self, strategy: "Strategy"):
+        self._strategy = strategy
+
+    def __enter__(self):
+        _strategy_stack().append(self._strategy)
+        return self._strategy
+
+    def __exit__(self, *exc):
+        popped = _strategy_stack().pop()
+        assert popped is self._strategy, "unbalanced strategy scopes"
+        return False
+
+
+class Strategy:
+    """Base: a named device mesh + pure-data-parallel sharding policy."""
+
+    def __init__(self, devices: Sequence | None = None, *, local: bool = False):
+        self._mesh = mesh_lib.make_mesh(devices=devices, local=local)
+
+    # -- core surface --------------------------------------------------------
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def data_axis(self) -> str:
+        return mesh_lib.DATA_AXIS
+
+    @property
+    def num_replicas_in_sync(self) -> int:
+        """Global replica count — TF's ``strategy.num_replicas_in_sync``
+        (verified == 2 in the reference's 2-worker run, SURVEY.md §3.5)."""
+        return self._mesh.devices.size
+
+    def scope(self) -> _Scope:
+        """Context manager pinning this strategy as current
+        (tf_dist_example.py:56-57 ergonomics)."""
+        return _Scope(self)
+
+    # -- sharding policy -----------------------------------------------------
+
+    def param_sharding(self):
+        """Replicated — MirroredVariable semantics (SURVEY.md D4)."""
+        return mesh_lib.replicated(self._mesh)
+
+    def batch_sharding(self):
+        """Leading dim split across the data axis (SURVEY.md D14)."""
+        return mesh_lib.batch_sharded(self._mesh, self.data_axis)
+
+    def replicate(self, tree, *, broadcast: bool | None = None):
+        """Place params replicated on the mesh; in multi-process jobs,
+        broadcast process 0's values first (D4 init broadcast)."""
+        import jax
+
+        if broadcast is None:
+            broadcast = jax.process_count() > 1
+        return mesh_lib.replicate(tree, self._mesh, broadcast=broadcast)
+
+    def distribute_batch(self, batch):
+        """Host batch pytree -> global device array, batch-dim sharded."""
+        return mesh_lib.shard_batch(batch, self._mesh, self.data_axis)
+
+    def reduce(self, op: ReduceOp | str, value):
+        """Host-side reduction of a per-replica value to a single result."""
+        import jax.numpy as jnp
+
+        op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
+        v = jnp.asarray(value)
+        if op is ReduceOp.SUM:
+            return v.sum(axis=0) if v.ndim else v
+        if op is ReduceOp.MEAN:
+            return v.mean(axis=0) if v.ndim else v
+        raise ValueError(f"host-side reduce supports SUM/MEAN, got {op}")
+
+    def __repr__(self) -> str:
+        return (f"{type(self).__name__}(replicas={self.num_replicas_in_sync}, "
+                f"mesh={tuple(self._mesh.shape.items())})")
+
+
+class DefaultStrategy(Strategy):
+    """No distribution: one device, the implicit strategy when none is scoped.
+
+    Matches the baseline "strategy off" configuration (BASELINE.md config 1)
+    and TF's default-strategy fallback."""
+
+    def __init__(self):
+        import jax
+
+        super().__init__(devices=[jax.local_devices()[0]])
+
+
+class MirroredStrategy(Strategy):
+    """Sync data parallelism over one host's devices (README.md:15-19).
+
+    Every variable is mirrored on each local device; gradients are all-reduced
+    each batch. ``devices=None`` uses all local devices — the reference's
+    "no GPUs -> CPU" degradation (README.md:34) falls out naturally because the
+    mesh is built from whatever devices exist.
+    """
+
+    def __init__(self, devices: Sequence | None = None):
+        super().__init__(devices=devices, local=devices is None)
+        logger.info("MirroredStrategy over %d device(s): %s",
+                    self.num_replicas_in_sync,
+                    [str(d) for d in self._mesh.devices.flat])
+
+
+class MultiWorkerMirroredStrategy(Strategy):
+    """Sync data parallelism across all cluster processes (README.md:21-29).
+
+    Construction performs cluster bring-up exactly where the reference does it
+    (strategy __init__ starts servers and blocks for peers, SURVEY.md §3.1):
+
+    1. ``bootstrap.initialize()`` — TF_CONFIG (or TPU-pod autodetect) ->
+       ``jax.distributed.initialize``; blocks until all processes join.
+    2. Mesh over every global device (ICI within a slice, DCN across slices —
+       XLA routes collectives; there is no RING/NCCL choice to make,
+       ``communication`` is accepted for compatibility, README.md:23).
+    3. Startup barrier, the analog of the reference's dummy-all-reduce barrier
+       (tf:...collective_all_reduce_strategy.py:1043-1066).
+
+    With one process and no cluster config this degrades to MirroredStrategy
+    behavior (README.md:34): the mesh is just the local devices.
+    """
+
+    def __init__(self,
+                 communication: CollectiveCommunication | str | None = None,
+                 cluster_config=None):
+        import jax
+
+        self.communication = CollectiveCommunication.resolve(communication)
+        bootstrap.initialize(config=cluster_config)
+        super().__init__()  # all global devices
+        bootstrap.barrier("MultiWorkerMirroredStrategy_init")
+        # Bring-up log, the analog of TF's "MultiWorkerMirroredStrategy with
+        # cluster_spec = {...}, num_workers = N" line (SURVEY.md §3.5).
+        cfg = bootstrap.cluster_config()
+        logger.info(
+            "MultiWorkerMirroredStrategy up: num_workers = %d, "
+            "num_replicas_in_sync = %d, communication = %s, cluster_spec = %s",
+            jax.process_count(), self.num_replicas_in_sync,
+            self.communication.name,
+            dict(cfg.cluster.jobs) if cfg else "<auto>")
+
+    @property
+    def is_chief(self) -> bool:
+        return bootstrap.is_chief()
+
+
+class ParameterServerStrategy:
+    """Async parameter-server training — intentionally not implemented.
+
+    The reference describes PS training only to recommend ring-allreduce over
+    it (bandwidth bottleneck at the PS, README.md:5-7) and never demonstrates
+    it (SURVEY.md D19, §2.3). Sync data parallelism via
+    MultiWorkerMirroredStrategy is the supported path.
+    """
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ParameterServerStrategy is a documented non-goal: the reference "
+            "recommends against async PS training (README.md:5-7) and never "
+            "exercises it. Use MultiWorkerMirroredStrategy.")
+
+
+_default_strategy: Optional[DefaultStrategy] = None
+
+
+def get_strategy() -> Strategy:
+    """Innermost scoped strategy, or the (cached) DefaultStrategy — identity-
+    stable like ``tf.distribute.get_strategy()``."""
+    stack = _strategy_stack()
+    if stack:
+        return stack[-1]
+    global _default_strategy
+    if _default_strategy is None:
+        _default_strategy = DefaultStrategy()
+    return _default_strategy
+
+
+def has_strategy() -> bool:
+    return bool(_strategy_stack())
