@@ -1,0 +1,107 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4: chief-only write, restore parity,
+divergence-free resume)."""
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.models import Dense, Sequential
+from tpu_dist.ops import SGD, SparseCategoricalCrossentropy
+from tpu_dist.training import ModelCheckpoint, checkpoint
+from tpu_dist.data import Dataset
+
+
+def _model(lr=0.1):
+    m = Sequential([Dense(16, activation="relu"), Dense(4)], input_shape=(8,))
+    m.compile(loss=SparseCategoricalCrossentropy(from_logits=True),
+              optimizer=SGD(learning_rate=lr), metrics=["accuracy"])
+    return m
+
+
+def _ds(n=128, batch=32):
+    rng = np.random.default_rng(1)
+    y = rng.integers(4, size=n)
+    x = (np.eye(8)[y * 2] + rng.normal(0, 0.1, (n, 8))).astype(np.float32)
+    return Dataset.from_tensor_slices((x, y.astype(np.int64))).batch(batch)
+
+
+class TestSaveRestore:
+    def test_roundtrip_preserves_params(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=4, verbose=0)
+        before = model.predict(np.ones((4, 8), np.float32))
+        model.save_weights(tmp_path, step=5)
+
+        with s.scope():
+            fresh = _model()
+        restored_step = fresh.load_weights(tmp_path)
+        assert restored_step == 5
+        after = fresh.predict(np.ones((4, 8), np.float32))
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+
+    def test_resume_continues_identically(self, tmp_path, eight_devices):
+        """Divergence-free resume (SURVEY.md hard-part #3): train 2 epochs
+        straight vs train 1 + checkpoint + restore + 1 more; identical."""
+        def fresh():
+            s = td.MirroredStrategy()
+            with s.scope():
+                return _model()
+
+        ds = _ds()
+        a = fresh()
+        h = a.fit(ds, epochs=2, steps_per_epoch=4, verbose=0, seed=3)
+
+        b = fresh()
+        b.fit(ds, epochs=1, steps_per_epoch=4, verbose=0, seed=3)
+        b.save_weights(tmp_path, step=1)
+        c = fresh()
+        c.fit(ds, epochs=0, steps_per_epoch=4, verbose=0, seed=3)  # materialize
+        c.load_weights(tmp_path)
+        h2 = c.fit(ds, epochs=2, steps_per_epoch=4, verbose=0, seed=3,
+                   initial_epoch=1)
+        np.testing.assert_allclose(
+            h.history["loss"][-1], h2.history["loss"][-1], rtol=1e-4)
+
+    def test_latest_and_explicit_step(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0)
+        model.save_weights(tmp_path, step=1)
+        model.save_weights(tmp_path, step=7)
+        assert checkpoint.latest_step(tmp_path) == 7
+        assert checkpoint.all_steps(tmp_path) == [1, 7]
+
+    def test_restore_missing_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            checkpoint.restore(tmp_path, {"w": np.zeros(2)})
+
+    def test_shape_mismatch_rejected(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0)
+        model.save_weights(tmp_path, step=0)
+        bad_template = {"params": {"dense": {"kernel": np.zeros((3, 3))}}}
+        with pytest.raises((KeyError, ValueError)):
+            checkpoint.restore(tmp_path, bad_template)
+
+
+class TestModelCheckpointCallback:
+    def test_writes_each_epoch_and_gc(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=3, steps_per_epoch=2, verbose=0,
+                  callbacks=[ModelCheckpoint(tmp_path, max_to_keep=2)])
+        assert checkpoint.all_steps(tmp_path) == [1, 2]  # epoch 0 collected
+
+    def test_save_best_only(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model(lr=0.0)  # loss never improves after epoch 0
+        model.fit(_ds(), epochs=3, steps_per_epoch=2, verbose=0,
+                  callbacks=[ModelCheckpoint(tmp_path, save_best_only=True)])
+        assert len(checkpoint.all_steps(tmp_path)) == 1

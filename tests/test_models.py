@@ -1,0 +1,157 @@
+"""Layer and model tests: shape inference, parameter creation, forward pass.
+
+Covers the layer vocabulary the reference model exercises (SURVEY.md R5) and
+the exact 8-variable structure the survey verified at runtime (§3.2/§3.5: the
+MNIST CNN has 8 variables — 2 conv kernel+bias, 2 dense kernel+bias)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.models import (
+    Activation,
+    AveragePooling2D,
+    BatchNormalization,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    GlobalAveragePooling2D,
+    MaxPooling2D,
+    Sequential,
+    build_cnn_model,
+)
+
+
+def _init_apply(layer, in_shape, x, **kw):
+    params, state, out_shape = layer.init(jax.random.PRNGKey(0), in_shape)
+    y, new_state = layer.apply(params, state, x, **kw)
+    return params, out_shape, y, new_state
+
+
+class TestLayers:
+    def test_conv2d_valid_shapes(self):
+        x = jnp.ones((2, 28, 28, 1))
+        params, out_shape, y, _ = _init_apply(
+            Conv2D(32, 3, activation="relu"), (28, 28, 1), x)
+        assert out_shape == (26, 26, 32)
+        assert y.shape == (2, 26, 26, 32)
+        assert params["kernel"].shape == (3, 3, 1, 32)
+        assert float(y.min()) >= 0.0  # relu applied
+
+    def test_conv2d_same_padding_and_stride(self):
+        x = jnp.ones((1, 8, 8, 3))
+        _, out_shape, y, _ = _init_apply(
+            Conv2D(4, 3, strides=2, padding="same"), (8, 8, 3), x)
+        assert out_shape == (4, 4, 4) and y.shape == (1, 4, 4, 4)
+
+    def test_maxpool_matches_reference_default(self):
+        # Keras MaxPooling2D() default: pool 2, stride 2, valid.
+        x = jnp.arange(16, dtype=jnp.float32).reshape(1, 4, 4, 1)
+        _, out_shape, y, _ = _init_apply(MaxPooling2D(), (4, 4, 1), x)
+        assert out_shape == (2, 2, 1)
+        np.testing.assert_array_equal(
+            y[0, :, :, 0], [[5, 7], [13, 15]])
+
+    def test_avgpool(self):
+        x = jnp.ones((1, 4, 4, 2))
+        _, out_shape, y, _ = _init_apply(AveragePooling2D(), (4, 4, 2), x)
+        assert out_shape == (2, 2, 2)
+        np.testing.assert_allclose(y, np.ones((1, 2, 2, 2)))
+
+    def test_global_avg_pool(self):
+        x = jnp.arange(8, dtype=jnp.float32).reshape(1, 2, 2, 2)
+        _, out_shape, y, _ = _init_apply(GlobalAveragePooling2D(), (2, 2, 2), x)
+        assert out_shape == (2,)
+        np.testing.assert_allclose(y[0], [(0 + 2 + 4 + 6) / 4, (1 + 3 + 5 + 7) / 4])
+
+    def test_flatten_dense(self):
+        x = jnp.ones((2, 3, 3, 2))
+        _, out_shape, y, _ = _init_apply(Flatten(), (3, 3, 2), x)
+        assert out_shape == (18,) and y.shape == (2, 18)
+        params, out_shape, z, _ = _init_apply(Dense(5), (18,), y)
+        assert out_shape == (5,) and z.shape == (2, 5)
+        assert params["kernel"].shape == (18, 5)
+
+    def test_batchnorm_train_vs_inference(self):
+        bn = BatchNormalization(momentum=0.5)
+        x = jax.random.normal(jax.random.PRNGKey(2), (64, 4)) * 3 + 1
+        params, state, _ = bn.init(jax.random.PRNGKey(0), (4,))
+        y, new_state = bn.apply(params, state, x, training=True)
+        # Normalized output: ~zero mean, ~unit variance.
+        np.testing.assert_allclose(np.asarray(y.mean(0)), np.zeros(4), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(y.std(0)), np.ones(4), atol=2e-2)
+        # Running stats moved toward batch stats.
+        assert not np.allclose(new_state["mean"], state["mean"])
+        # Inference path uses running stats, state unchanged.
+        y2, state2 = bn.apply(params, new_state, x, training=False)
+        assert state2 is new_state
+
+    def test_dropout_train_and_inference(self):
+        d = Dropout(0.5)
+        params, state, _ = d.init(jax.random.PRNGKey(0), (100,))
+        x = jnp.ones((4, 100))
+        y, _ = d.apply(params, state, x, training=True,
+                       rng=jax.random.PRNGKey(1))
+        dropped = float((y == 0).mean())
+        assert 0.3 < dropped < 0.7
+        y_inf, _ = d.apply(params, state, x, training=False)
+        np.testing.assert_array_equal(y_inf, x)
+        with pytest.raises(ValueError, match="rng"):
+            d.apply(params, state, x, training=True)
+
+    def test_unknown_activation(self):
+        with pytest.raises(ValueError, match="unknown activation"):
+            _init_apply(Activation("swoosh"), (4,), jnp.ones((1, 4)))
+
+
+class TestSequential:
+    def test_reference_cnn_has_8_variables(self):
+        # SURVEY.md §3.2/§3.5: exactly 8 model variables observed in the
+        # reference run (2x conv kernel+bias, 2x dense kernel+bias).
+        model = build_cnn_model()
+        variables = model.init(0)
+        leaves = jax.tree_util.tree_leaves(variables["params"])
+        assert len(leaves) == 8
+        assert model.output_shape == (10,)
+
+    def test_reference_cnn_param_shapes(self):
+        model = build_cnn_model()
+        p = model.init(0)["params"]
+        assert p["conv2d"]["kernel"].shape == (3, 3, 1, 32)
+        assert p["conv2d_1"]["kernel"].shape == (3, 3, 32, 64)
+        # 28->conv(26)->pool(13)->conv(11)->pool(5): 5*5*64 = 1600
+        assert p["dense"]["kernel"].shape == (1600, 128)
+        assert p["dense_1"]["kernel"].shape == (128, 10)
+
+    def test_forward_pass_shape_and_determinism(self):
+        model = build_cnn_model()
+        variables = model.init(42)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 28, 28, 1))
+        out = model(variables, x)
+        assert out.shape == (4, 10)
+        np.testing.assert_array_equal(out, model(variables, x))
+
+    def test_duplicate_layer_names_enumerated(self):
+        model = Sequential([Dense(4), Dense(4), Dense(2)], input_shape=(8,))
+        assert model.layer_names == ["dense", "dense_1", "dense_2"]
+
+    def test_empty_sequential_rejected(self):
+        with pytest.raises(ValueError, match="at least one layer"):
+            Sequential([])
+
+    def test_missing_input_shape_raises(self):
+        model = Sequential([Dense(4)])
+        with pytest.raises(ValueError, match="input_shape"):
+            model.init(0)
+
+    def test_state_threading_with_batchnorm(self):
+        model = Sequential([Dense(8), BatchNormalization(), Activation("relu")],
+                           input_shape=(4,))
+        v = model.init(0)
+        assert "batchnormalization" in v["state"]
+        x = jnp.ones((16, 4))
+        _, new_state = model.apply(v["params"], v["state"], x, training=True)
+        assert not np.allclose(new_state["batchnormalization"]["mean"],
+                               v["state"]["batchnormalization"]["mean"])

@@ -1,0 +1,127 @@
+"""Unit tests for ops: losses, metrics, optimizers, initializers
+(SURVEY.md §4 plan item 1: pure functions, no devices needed)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.ops import (
+    Adam,
+    SGD,
+    SparseCategoricalAccuracy,
+    SparseCategoricalCrossentropy,
+    initializers,
+    losses,
+    metrics,
+    optimizers,
+)
+
+
+class TestLosses:
+    def test_sparse_ce_matches_manual(self):
+        logits = jnp.array([[2.0, 1.0, 0.1], [0.1, 3.0, 0.2]])
+        labels = jnp.array([0, 1])
+        loss = SparseCategoricalCrossentropy(from_logits=True)(logits, labels)
+        log_probs = jax.nn.log_softmax(logits)
+        expected = -(log_probs[0, 0] + log_probs[1, 1]) / 2
+        np.testing.assert_allclose(loss, expected, rtol=1e-6)
+
+    def test_from_logits_false_takes_probs(self):
+        probs = jnp.array([[0.9, 0.1], [0.2, 0.8]])
+        loss = SparseCategoricalCrossentropy(from_logits=False)(
+            probs, jnp.array([0, 1]))
+        expected = -(np.log(0.9) + np.log(0.8)) / 2
+        np.testing.assert_allclose(loss, expected, rtol=1e-5)
+
+    def test_get_by_name_matches_keras_defaults(self):
+        # Keras string identifiers imply from_logits=False.
+        assert not losses.get("sparse_categorical_crossentropy").from_logits
+        with pytest.raises(ValueError, match="unknown loss"):
+            losses.get("nope")
+
+    def test_perfect_prediction_low_loss(self):
+        logits = jnp.array([[20.0, 0.0], [0.0, 20.0]])
+        loss = SparseCategoricalCrossentropy(from_logits=True)(
+            logits, jnp.array([0, 1]))
+        assert float(loss) < 1e-6
+
+
+class TestMetrics:
+    def test_accuracy_accumulates_across_updates(self):
+        m = SparseCategoricalAccuracy()
+        s = m.init()
+        s = m.update(s, jnp.array([[1.0, 0.0], [0.0, 1.0]]), jnp.array([0, 1]))
+        s = m.update(s, jnp.array([[1.0, 0.0]]), jnp.array([1]))
+        assert float(m.result(s)) == pytest.approx(2 / 3)
+
+    def test_empty_state_result_is_zero(self):
+        m = SparseCategoricalAccuracy()
+        assert float(m.result(m.init())) == 0.0
+
+    def test_get_by_name(self):
+        assert metrics.get("accuracy").name == "accuracy"
+        with pytest.raises(ValueError, match="unknown metric"):
+            metrics.get("nope")
+
+
+class TestOptimizers:
+    def _quadratic_descends(self, opt, steps=120, tol=1e-2):
+        params = {"w": jnp.array([3.0, -2.0])}
+        state = opt.init(params)
+        grad_fn = jax.grad(lambda p: jnp.sum(p["w"] ** 2))
+        for _ in range(steps):
+            params, state = opt.update(grad_fn(params), state, params)
+        assert float(jnp.abs(params["w"]).max()) < tol
+
+    def test_sgd_plain(self):
+        self._quadratic_descends(SGD(learning_rate=0.1))
+
+    def test_sgd_momentum_and_nesterov(self):
+        self._quadratic_descends(SGD(learning_rate=0.05, momentum=0.9))
+        self._quadratic_descends(SGD(learning_rate=0.05, momentum=0.9,
+                                     nesterov=True))
+
+    def test_adam(self):
+        self._quadratic_descends(Adam(learning_rate=0.1))
+
+    def test_sgd_matches_closed_form(self):
+        # One plain-SGD step: p' = p - lr * g (tf_dist_example.py:51 rule).
+        opt = SGD(learning_rate=0.001)
+        params = {"w": jnp.array([1.0])}
+        grads = {"w": jnp.array([2.0])}
+        new_params, _ = opt.update(grads, opt.init(params), params)
+        np.testing.assert_allclose(new_params["w"], [1.0 - 0.001 * 2.0])
+
+    def test_optax_wrapper(self):
+        import optax
+
+        self._quadratic_descends(optimizers.get(optax.sgd(0.1)))
+
+    def test_get_by_name(self):
+        assert isinstance(optimizers.get("sgd"), SGD)
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            optimizers.get("lion9000")
+
+
+class TestInitializers:
+    def test_glorot_bounds_and_determinism(self):
+        key = jax.random.PRNGKey(0)
+        w = initializers.glorot_uniform(key, (64, 32))
+        limit = np.sqrt(6.0 / (64 + 32))
+        assert float(jnp.abs(w).max()) <= limit
+        np.testing.assert_array_equal(
+            w, initializers.glorot_uniform(key, (64, 32)))
+
+    def test_conv_fans(self):
+        # (H, W, Cin, Cout) fan computation.
+        fan_in, fan_out = initializers._fans((3, 3, 16, 32))
+        assert fan_in == 16 * 9 and fan_out == 32 * 9
+
+    def test_he_normal_scale(self):
+        w = initializers.he_normal(jax.random.PRNGKey(1), (1024, 256))
+        assert float(w.std()) == pytest.approx(np.sqrt(2.0 / 1024), rel=0.1)
+
+    def test_unknown_name(self):
+        with pytest.raises(ValueError, match="unknown initializer"):
+            initializers.get("magic")

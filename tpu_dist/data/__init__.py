@@ -1,0 +1,24 @@
+"""Input-pipeline layer: datasets, combinators, shard policies, distribution."""
+
+from tpu_dist.data.pipeline import AutoShardPolicy, Dataset, Options
+from tpu_dist.data.sources import (
+    image_shape,
+    load,
+    load_arrays,
+    num_classes,
+)
+from tpu_dist.data.sharding import resolve_policy, shard_dataset
+from tpu_dist.data.distribute import DistributedDataset
+
+__all__ = [
+    "AutoShardPolicy",
+    "Dataset",
+    "Options",
+    "image_shape",
+    "load",
+    "load_arrays",
+    "num_classes",
+    "resolve_policy",
+    "shard_dataset",
+    "DistributedDataset",
+]

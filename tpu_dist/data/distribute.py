@@ -1,0 +1,96 @@
+"""Distributed dataset delivery: per-host streams -> global device arrays.
+
+The TPU-native replacement for TF's distributed-dataset wrappers (SURVEY.md
+D14): where ``experimental_distribute_dataset`` built per-worker iterators and
+PerReplica value structures (tf:python/distribute/input_lib.py), here each
+process iterates its host-local numpy pipeline and every step's local batch is
+assembled into ONE global ``jax.Array`` sharded over the mesh's data axis
+(``jax.make_array_from_process_local_data`` multi-process,
+``jax.device_put`` single-process). The jitted train step consumes the global
+array; XLA sees a single SPMD program — there is no per-replica bookkeeping.
+
+Two delivery modes, matching the reference's two supported paths (SURVEY.md
+§3.4):
+
+* **with_options(OFF)** (the reference's chosen mode, tf_dist_example.py:34-37):
+  every worker iterates the full stream with an independent shuffle; each
+  process's batch is its own contribution, so the effective global batch is
+  ``local_batch x num_processes`` distinct samples (README.md:113-120).
+* **distribute (AUTO/DATA/FILE)** (the commented alternative,
+  tf_dist_example.py:36): the user batches to GLOBAL_BATCH_SIZE; each process
+  keeps its 1/num_processes slice, so the global array's leading dim is the
+  global batch size.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator
+
+import numpy as np
+
+from tpu_dist.data.pipeline import AutoShardPolicy, Dataset, _map_structure
+from tpu_dist.data.sharding import resolve_policy, shard_dataset
+
+logger = logging.getLogger("tpu_dist.data")
+
+
+class DistributedDataset:
+    """Iterable of mesh-placed global batches for a strategy.
+
+    ``strategy.experimental_distribute_dataset(dataset)`` returns one of these
+    (the tf_dist_example.py:36 analog); ``fit`` also auto-wraps plain Datasets
+    the way the Keras trainer does (keras:src/backend/tensorflow/
+    trainer.py:750-755, SURVEY.md D15).
+    """
+
+    def __init__(self, dataset: Dataset, strategy,
+                 policy: AutoShardPolicy | None = None):
+        import jax
+
+        self._strategy = strategy
+        self._num_processes = jax.process_count()
+        self._process_index = jax.process_index()
+        effective = (policy if policy is not None
+                     else dataset.auto_shard_policy)
+        if effective == AutoShardPolicy.OFF:
+            # Reference mode: full stream per worker, local batch as produced.
+            self._local = dataset
+            self._policy = AutoShardPolicy.OFF
+        else:
+            self._policy = resolve_policy(dataset, self._num_processes, effective)
+            self._local = shard_dataset(
+                dataset, self._num_processes, self._process_index,
+                self._policy, pre_batched=True)
+        if self._num_processes > 1:
+            logger.info(
+                "DistributedDataset: policy=%s process=%d/%d",
+                self._policy.name, self._process_index, self._num_processes)
+
+    @property
+    def auto_shard_policy(self) -> AutoShardPolicy:
+        return self._policy
+
+    def __iter__(self) -> Iterator:
+        devices_per_process = len(self._strategy.mesh.local_devices)
+
+        for batch in self._local:
+            batch = _map_structure(np.asarray, batch)
+            leading = {a.shape[0] for a in _leaves(batch)}
+            if len(leading) != 1:
+                raise ValueError(
+                    f"batch components disagree on batch dim: {leading}")
+            (b,) = leading
+            if b % devices_per_process:
+                raise ValueError(
+                    f"per-process batch {b} not divisible by {devices_per_process} "
+                    "local device(s); adjust the batch size so every replica "
+                    "gets an equal shard (same constraint as TF per-replica "
+                    "splitting)")
+            yield self._strategy.distribute_batch(batch)
+
+
+def _leaves(tree):
+    out = []
+    _map_structure(out.append, tree)
+    return out

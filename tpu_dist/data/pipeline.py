@@ -1,0 +1,358 @@
+"""Host-side input pipeline: a tf.data-shaped Dataset for per-host delivery.
+
+Re-provides the input-pipeline surface the reference exercises (SURVEY.md D13,
+§3.4): ``map`` / ``cache`` / ``shuffle`` / ``batch`` combinators
+(tf_dist_example.py:20-33), ``from_tensor_slices`` for numpy data
+(README.md:121-129), and ``Options`` carrying
+``experimental_distribute.auto_shard_policy`` (tf_dist_example.py:34-37) with
+TF's enum values (tf:python/data/ops/options.py:89-116).
+
+TPU-native stance: the input pipeline is *host-side numpy* — TPU sees only the
+assembled global batch (``tpu_dist.data.distribute``). There is no graph of
+dataset ops to rewrite; the autoshard policy that TF implements as a C++
+Grappler pass over the dataset graph (auto_shard.cc) becomes a plain index
+transformation in ``tpu_dist.data.sharding``. Shuffling is buffer-based with
+the same semantics as tf.data's ``shuffle(buffer_size)``: an *unseeded* shuffle
+draws a fresh order per iteration/worker — load-bearing for the reference's
+OFF-policy mode where every worker iterates an independently-shuffled full
+stream (README.md:113-120, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import queue as queue_lib
+import threading
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+
+class AutoShardPolicy(enum.IntEnum):
+    """TF ``tf.data.experimental.AutoShardPolicy`` values
+    (tf:python/data/ops/options.py:89-116). The reference sets OFF
+    (tf_dist_example.py:35)."""
+
+    OFF = -1
+    AUTO = 0
+    FILE = 1
+    DATA = 2
+    HINT = 3
+
+
+class _DistributeOptions:
+    """Mirror of ``options.experimental_distribute`` attribute shape."""
+
+    def __init__(self) -> None:
+        self.auto_shard_policy = AutoShardPolicy.AUTO
+
+    def __repr__(self) -> str:
+        return f"_DistributeOptions(auto_shard_policy={self.auto_shard_policy!r})"
+
+
+class Options:
+    """Dataset options — the subset the reference uses: the auto-shard policy
+    (tf_dist_example.py:34-35: ``options.experimental_distribute
+    .auto_shard_policy = AutoShardPolicy.OFF``)."""
+
+    def __init__(self) -> None:
+        self.experimental_distribute = _DistributeOptions()
+
+    def __repr__(self) -> str:
+        return f"Options({self.experimental_distribute!r})"
+
+
+def _map_structure(fn, element):
+    if isinstance(element, tuple):
+        return tuple(_map_structure(fn, e) for e in element)
+    if isinstance(element, dict):
+        return {k: _map_structure(fn, v) for k, v in element.items()}
+    return fn(element)
+
+
+def _batch_structure(elements: Sequence) -> Any:
+    """Stack a list of identically-structured elements into batched arrays."""
+    first = elements[0]
+    if isinstance(first, tuple):
+        return tuple(_batch_structure([e[i] for e in elements])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: _batch_structure([e[k] for e in elements]) for k in first}
+    return np.stack([np.asarray(e) for e in elements])
+
+
+class Dataset:
+    """A lazily-evaluated element pipeline (host-side, numpy).
+
+    Built from a factory returning a fresh iterator per epoch — iterating a
+    Dataset twice replays the source (and re-randomizes unseeded shuffles),
+    matching tf.data re-iteration semantics the reference relies on for its
+    per-worker independent shuffles (SURVEY.md §3.4).
+    """
+
+    def __init__(self, it_factory: Callable[[], Iterator], *,
+                 options: Options | None = None,
+                 cardinality: int | None = None,
+                 num_files: int = 1):
+        self._it_factory = it_factory
+        self._options = options or Options()
+        self._cardinality = cardinality
+        #: Source-file count, drives AutoShardPolicy.FILE/AUTO decisions
+        #: (TF autoshards by file when the source has files, auto_shard.cc).
+        self.num_files = num_files
+
+    # -- constructors --------------------------------------------------------
+
+    @staticmethod
+    def from_tensor_slices(tensors) -> "Dataset":
+        """Elements are slices along the leading axis — the README.md:121-129
+        numpy-conversion path."""
+        arrays = _map_structure(np.asarray, tensors)
+        leaves = []
+        _map_structure(leaves.append, arrays)
+        if not leaves:
+            raise ValueError("from_tensor_slices requires at least one array")
+        n = len(leaves[0])
+        for leaf in leaves:
+            if len(leaf) != n:
+                raise ValueError(
+                    f"all arrays must share the leading dim, got {len(leaf)} != {n}")
+
+        def factory():
+            for i in range(n):
+                yield _map_structure(lambda a: a[i], arrays)
+
+        return Dataset(factory, cardinality=n)
+
+    @staticmethod
+    def from_generator(gen_factory: Callable[[], Iterable]) -> "Dataset":
+        return Dataset(lambda: iter(gen_factory()))
+
+    @staticmethod
+    def range(n: int) -> "Dataset":
+        return Dataset(lambda: iter(range(n)), cardinality=n)
+
+    # -- combinators (each returns a new Dataset; reference set at
+    #    tf_dist_example.py:20-37) -------------------------------------------
+
+    def map(self, fn: Callable) -> "Dataset":
+        def factory():
+            for el in self._it_factory():
+                yield fn(*el) if isinstance(el, tuple) else fn(el)
+
+        return self._derive(factory)
+
+    def filter(self, predicate: Callable) -> "Dataset":
+        def factory():
+            for el in self._it_factory():
+                keep = predicate(*el) if isinstance(el, tuple) else predicate(el)
+                if keep:
+                    yield el
+
+        return self._derive(factory, cardinality=None)
+
+    def cache(self) -> "Dataset":
+        """Materialize on first full pass; later passes replay the cache
+        (tf_dist_example.py:30 uses this to avoid re-decoding MNIST).
+
+        Only a COMPLETE pass publishes the cache: a partially-consumed or
+        concurrent iterator never corrupts it (it just re-reads the source),
+        and no lock is held across yields."""
+        store: list = []
+        complete = threading.Event()
+        lock = threading.Lock()
+
+        def factory():
+            if complete.is_set():
+                yield from store
+                return
+            local: list = []
+            for el in self._it_factory():
+                local.append(el)
+                yield el
+            with lock:
+                if not complete.is_set():
+                    store.extend(local)
+                    complete.set()
+
+        return self._derive(factory)
+
+    def shuffle(self, buffer_size: int, seed: int | None = None,
+                reshuffle_each_iteration: bool = True) -> "Dataset":
+        """Buffer-based shuffle with tf.data semantics: fill a buffer of
+        ``buffer_size``, emit a random occupant, refill. Unseeded => each
+        iteration (and each worker process) draws an independent order — the
+        property the reference's OFF-policy mode depends on (README.md:113-120).
+        """
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        if seed is None and not reshuffle_each_iteration:
+            # tf.data semantics: an unseeded non-reshuffling dataset picks one
+            # random seed at construction and replays that order every pass.
+            seed = int(np.random.default_rng().integers(2**31))
+        epoch_counter = itertools.count()
+
+        def factory():
+            it = self._it_factory()
+            epoch = next(epoch_counter)
+            if seed is None:
+                rng = np.random.default_rng()
+            else:
+                rng = np.random.default_rng(
+                    seed + (epoch if reshuffle_each_iteration else 0))
+            buf = list(itertools.islice(it, buffer_size))
+            for el in it:
+                idx = rng.integers(len(buf))
+                out, buf[idx] = buf[idx], el
+                yield out
+            rng.shuffle(buf)
+            yield from buf
+
+        return self._derive(factory)
+
+    def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+
+        def factory():
+            acc = []
+            for el in self._it_factory():
+                acc.append(el)
+                if len(acc) == batch_size:
+                    yield _batch_structure(acc)
+                    acc = []
+            if acc and not drop_remainder:
+                yield _batch_structure(acc)
+
+        card = None
+        if self._cardinality is not None:
+            card = (self._cardinality // batch_size if drop_remainder
+                    else -(-self._cardinality // batch_size))
+        return self._derive(factory, cardinality=card)
+
+    def repeat(self, count: int | None = None) -> "Dataset":
+        def factory():
+            n = 0
+            while count is None or n < count:
+                it = self._it_factory()
+                empty = True
+                for el in it:
+                    empty = False
+                    yield el
+                if empty:
+                    return
+                n += 1
+
+        card = None
+        if count is not None and self._cardinality is not None:
+            card = count * self._cardinality
+        return self._derive(factory, cardinality=card)
+
+    def take(self, count: int) -> "Dataset":
+        def factory():
+            yield from itertools.islice(self._it_factory(), count)
+
+        card = count if self._cardinality is None else min(count, self._cardinality)
+        return self._derive(factory, cardinality=card)
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """Every ``num_shards``-th element starting at ``index`` — tf.data's
+        ``Dataset.shard``, the primitive DATA autosharding lowers to."""
+        if not 0 <= index < num_shards:
+            raise ValueError(f"index {index} not in [0, {num_shards})")
+
+        def factory():
+            yield from itertools.islice(self._it_factory(), index, None, num_shards)
+
+        card = None
+        if self._cardinality is not None:
+            card = (self._cardinality - index + num_shards - 1) // num_shards
+        return self._derive(factory, cardinality=card)
+
+    def prefetch(self, buffer_size: int = 2) -> "Dataset":
+        """Background-thread prefetch, keeping host input off the step critical
+        path (SURVEY.md §3.4 'cache+prefetch keep it off the critical path')."""
+        if buffer_size < 1:
+            raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+
+        def factory():
+            q: queue_lib.Queue = queue_lib.Queue(maxsize=buffer_size)
+            stop = threading.Event()
+            _SENTINEL = object()
+
+            def _put(item) -> bool:
+                # Bounded put that gives up when the consumer abandoned the
+                # iterator (e.g. evaluate(steps=N) breaking early) — otherwise
+                # the producer thread would block forever and pin the upstream
+                # pipeline.
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.05)
+                        return True
+                    except queue_lib.Full:
+                        continue
+                return False
+
+            def producer():
+                try:
+                    for el in self._it_factory():
+                        if not _put(el):
+                            return
+                except BaseException as e:  # propagate into the consumer
+                    _put((_SENTINEL, e))
+                    return
+                _put((_SENTINEL, None))
+
+            t = threading.Thread(target=producer, daemon=True)
+            t.start()
+            try:
+                while True:
+                    item = q.get()
+                    if (isinstance(item, tuple) and len(item) == 2
+                            and item[0] is _SENTINEL):
+                        if item[1] is not None:
+                            raise item[1]
+                        return
+                    yield item
+            finally:
+                stop.set()
+
+        return self._derive(factory)
+
+    def with_options(self, options: Options) -> "Dataset":
+        """Attach options — the reference's auto-shard-policy carrier
+        (tf_dist_example.py:37)."""
+        ds = self._derive(self._it_factory)
+        ds._options = options
+        return ds
+
+    # -- introspection -------------------------------------------------------
+
+    @property
+    def options(self) -> Options:
+        return self._options
+
+    @property
+    def auto_shard_policy(self) -> AutoShardPolicy:
+        return self._options.experimental_distribute.auto_shard_policy
+
+    def cardinality(self) -> int | None:
+        """Element count if statically known, else None (unknown)."""
+        return self._cardinality
+
+    def __iter__(self) -> Iterator:
+        return self._it_factory()
+
+    def as_numpy_iterator(self) -> Iterator:
+        return iter(self)
+
+    def _derive(self, factory, cardinality: int | None = "inherit") -> "Dataset":  # type: ignore[assignment]
+        ds = Dataset(
+            factory,
+            options=self._options,
+            cardinality=(self._cardinality if cardinality == "inherit"
+                         else cardinality),
+            num_files=self.num_files,
+        )
+        return ds

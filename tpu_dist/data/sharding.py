@@ -1,0 +1,105 @@
+"""Auto-shard policy application — TF's Grappler ``auto_shard`` pass, natively.
+
+TF implements dataset sharding as a C++ graph rewrite over the dataset op graph
+(tensorflow/core/grappler/optimizers/data/auto_shard.cc, SURVEY.md D13). Our
+pipeline is a host-side element stream, so every policy reduces to a plain
+index transformation — same contract, no graph rewriting:
+
+* OFF  — untouched: every worker iterates the full stream. The reference's
+  chosen mode (tf_dist_example.py:35; README.md:113-120 explains why: each
+  worker draws an independently-shuffled batch, gradients still all-reduced).
+* DATA — each worker keeps every ``num_shards``-th element (applied pre-batch)
+  or its contiguous 1/num_shards slice of each batch (applied post-batch, the
+  rebatch path TF uses for pre-batched distributed datasets).
+* FILE — shard source files across workers; in-memory sources have one "file",
+  so explicit FILE over fewer files than workers raises (TF errors likewise),
+  while AUTO falls back to DATA with a warning (TF's fallback behavior).
+* HINT — treated as DATA (TF replaces SHARD_HINT placeholders with the
+  worker's shard index).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpu_dist.data.pipeline import AutoShardPolicy, Dataset
+
+logger = logging.getLogger("tpu_dist.data")
+
+
+def resolve_policy(dataset: Dataset, num_shards: int,
+                   policy: AutoShardPolicy | None = None) -> AutoShardPolicy:
+    """Collapse AUTO/HINT into the concrete policy that will be applied."""
+    if policy is None:
+        policy = dataset.auto_shard_policy
+    if policy == AutoShardPolicy.HINT:
+        return AutoShardPolicy.DATA
+    if policy == AutoShardPolicy.AUTO:
+        if dataset.num_files >= num_shards > 1:
+            return AutoShardPolicy.FILE
+        if num_shards > 1:
+            logger.warning(
+                "AutoShardPolicy.AUTO: source has %d file(s) < %d workers; "
+                "falling back to DATA sharding", dataset.num_files, num_shards)
+        return AutoShardPolicy.DATA
+    return policy
+
+
+def shard_dataset(dataset: Dataset, num_shards: int, index: int,
+                  policy: AutoShardPolicy | None = None,
+                  *, pre_batched: bool = False) -> Dataset:
+    """Apply an auto-shard policy for worker ``index`` of ``num_shards``.
+
+    ``pre_batched=True`` means elements are already batches (the
+    ``experimental_distribute_dataset`` path, where the user batched to the
+    global batch size, tf_dist_example.py:33+36): DATA sharding then slices
+    each batch instead of striding elements.
+    """
+    if not 0 <= index < num_shards:
+        raise ValueError(f"shard index {index} not in [0, {num_shards})")
+    if num_shards == 1:
+        return dataset
+    concrete = resolve_policy(dataset, num_shards, policy)
+
+    if concrete == AutoShardPolicy.OFF:
+        return dataset
+
+    if concrete == AutoShardPolicy.FILE:
+        if dataset.num_files < num_shards:
+            raise ValueError(
+                f"AutoShardPolicy.FILE requires >= {num_shards} source files, "
+                f"dataset has {dataset.num_files}. Use DATA or OFF "
+                "(tf.data raises the same way when files < workers).")
+        raise NotImplementedError(
+            "FILE sharding requires a file-backed source; in-memory sources "
+            "expose one logical file. Multi-file sources arrive with the "
+            "sharded-input-file loader.")
+
+    assert concrete == AutoShardPolicy.DATA
+    if pre_batched:
+        return _slice_batches(dataset, num_shards, index)
+    return dataset.shard(num_shards, index)
+
+
+def _slice_batches(dataset: Dataset, num_shards: int, index: int) -> Dataset:
+    """Per-batch contiguous slice — TF's rebatch-then-shard for pre-batched
+    distributed datasets (tf:python/distribute/input_lib.py path)."""
+    import numpy as np
+
+    def factory():
+        for batch in dataset:
+            def _slice(a):
+                a = np.asarray(a)
+                b = a.shape[0]
+                if b % num_shards:
+                    raise ValueError(
+                        f"global batch {b} not divisible by {num_shards} "
+                        "workers; make GLOBAL_BATCH_SIZE a multiple of the "
+                        "worker count (tf_dist_example.py:17-18 semantics)")
+                per = b // num_shards
+                return a[index * per:(index + 1) * per]
+
+            from tpu_dist.data.pipeline import _map_structure
+            yield _map_structure(_slice, batch)
+
+    return dataset._derive(factory)

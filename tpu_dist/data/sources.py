@@ -1,0 +1,166 @@
+"""Dataset sources: MNIST / Fashion-MNIST / CIFAR-10 with a tfds-like loader.
+
+Re-provides the reference's TFDS surface (SURVEY.md D18; tf_dist_example.py:15,
+27-29): ``load(name, split, as_supervised=True)`` returning a
+:class:`~tpu_dist.data.pipeline.Dataset` of ``(image, label)`` tuples, for the
+three benchmark datasets (BASELINE.md configs). Resolution order per dataset:
+
+1. Local files — idx/npz archives under ``$TPU_DIST_DATA_DIR``,
+   ``~/.keras/datasets``, or ``~/tensorflow_datasets`` (this framework never
+   downloads; training environments are frequently egress-free).
+2. Deterministic synthetic data with the real shapes/dtypes and
+   class-separable structure (a fixed per-class template plus noise), so
+   convergence tests remain meaningful — the same technique the survey's
+   verification run used (SURVEY.md §3.5 "synthetic MNIST-shaped data").
+"""
+
+from __future__ import annotations
+
+import gzip
+import logging
+import os
+import pathlib
+import struct
+from typing import Mapping
+
+import numpy as np
+
+from tpu_dist.data.pipeline import Dataset
+
+logger = logging.getLogger("tpu_dist.data")
+
+DATA_DIR_ENV = "TPU_DIST_DATA_DIR"
+
+#: name -> (image shape, num classes, official split sizes)
+_SPECS: Mapping[str, tuple[tuple[int, int, int], int, Mapping[str, int]]] = {
+    "mnist": ((28, 28, 1), 10, {"train": 60000, "test": 10000}),
+    "fashion_mnist": ((28, 28, 1), 10, {"train": 60000, "test": 10000}),
+    "cifar10": ((32, 32, 3), 10, {"train": 50000, "test": 10000}),
+}
+
+#: Synthetic sizes kept modest so zero-egress environments stay fast; override
+#: with load(..., synthetic_size=N).
+_SYNTHETIC_SIZES = {"train": 8192, "test": 1024}
+
+
+def _search_dirs() -> list[pathlib.Path]:
+    dirs = []
+    env = os.environ.get(DATA_DIR_ENV)
+    if env:
+        dirs.append(pathlib.Path(env))
+    home = pathlib.Path.home()
+    dirs += [home / ".keras" / "datasets", home / "tensorflow_datasets"]
+    return dirs
+
+
+def _read_idx(path: pathlib.Path) -> np.ndarray:
+    """Parse an IDX (MNIST-format) file, gzip or raw."""
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+_IDX_NAMES = {
+    ("mnist", "train"): ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    ("mnist", "test"): ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    ("fashion_mnist", "train"): ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+    ("fashion_mnist", "test"): ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+}
+
+
+def _try_local(name: str, split: str) -> tuple[np.ndarray, np.ndarray] | None:
+    shape, _, _ = _SPECS[name]
+    for base in _search_dirs():
+        # npz bundle (keras-style mnist.npz / cifar10.npz)
+        for fname in (f"{name}.npz", f"{name}-{split}.npz"):
+            p = base / fname
+            if p.is_file():
+                with np.load(p, allow_pickle=False) as z:
+                    kx, ky = (("x_train", "y_train") if split == "train"
+                              else ("x_test", "y_test"))
+                    if kx in z:
+                        x, y = z[kx], z[ky]
+                    elif "images" in z:
+                        x, y = z["images"], z["labels"]
+                    else:
+                        continue
+                logger.info("loaded %s/%s from %s", name, split, p)
+                return x.reshape((-1, *shape)), y.reshape(-1).astype(np.int64)
+        # idx files (raw MNIST distribution), possibly under a subdir
+        key = (name, split)
+        if key in _IDX_NAMES:
+            for sub in (base, base / name):
+                ix, iy = _IDX_NAMES[key]
+                for suffix in ("", ".gz"):
+                    px, py = sub / (ix + suffix), sub / (iy + suffix)
+                    if px.is_file() and py.is_file():
+                        x = _read_idx(px).reshape((-1, *shape))
+                        y = _read_idx(py).reshape(-1).astype(np.int64)
+                        logger.info("loaded %s/%s from %s", name, split, sub)
+                        return x, y
+    return None
+
+
+def _synthetic(name: str, split: str, size: int | None) -> tuple[np.ndarray, np.ndarray]:
+    """Class-separable synthetic images: per-class low-frequency template +
+    noise. Deterministic per (name, split) so every process/worker sees the
+    same underlying dataset — required for the OFF-policy 'every worker has the
+    full stream' semantics (README.md:113-120)."""
+    shape, num_classes, _ = _SPECS[name]
+    n = size or _SYNTHETIC_SIZES[split]
+    seed = abs(hash((name, split))) % (2**31)
+    rng = np.random.default_rng(seed)
+    h, w, c = shape
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    templates = np.stack([
+        127.5 * (1 + np.sin(2 * np.pi * ((k + 1) * xx / w + k * yy / h)
+                            / (1 + k % 3)))
+        for k in range(num_classes)
+    ])  # (classes, h, w)
+    labels = rng.integers(num_classes, size=n).astype(np.int64)
+    images = templates[labels][..., None].repeat(c, axis=-1)
+    images = images + rng.normal(0, 24.0, size=(n, h, w, c))
+    images = np.clip(images, 0, 255).astype(np.uint8)
+    logger.warning(
+        "no local copy of %s/%s found; using deterministic synthetic data "
+        "(%d samples). Set $%s to use real data.", name, split, n, DATA_DIR_ENV)
+    return images, labels
+
+
+def load_arrays(name: str, split: str = "train", *,
+                synthetic_size: int | None = None
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """(images uint8 [N,H,W,C], labels int64 [N]) for a named dataset."""
+    if name not in _SPECS:
+        raise ValueError(f"unknown dataset {name!r}; available: {sorted(_SPECS)}")
+    if split not in ("train", "test"):
+        raise ValueError(f"split must be 'train' or 'test', got {split!r}")
+    found = _try_local(name, split)
+    if found is not None:
+        return found
+    return _synthetic(name, split, synthetic_size)
+
+
+def load(name: str, split: str = "train", *, as_supervised: bool = True,
+         synthetic_size: int | None = None) -> Dataset:
+    """tfds.load-shaped entry point (tf_dist_example.py:15 usage):
+    ``load('mnist', split='train', as_supervised=True)`` yields
+    ``(image, label)`` tuples; ``as_supervised=False`` yields dicts."""
+    x, y = load_arrays(name, split, synthetic_size=synthetic_size)
+    if as_supervised:
+        ds = Dataset.from_tensor_slices((x, y))
+    else:
+        ds = Dataset.from_tensor_slices({"image": x, "label": y})
+    return ds
+
+
+def num_classes(name: str) -> int:
+    return _SPECS[name][1]
+
+
+def image_shape(name: str) -> tuple[int, int, int]:
+    return _SPECS[name][0]

@@ -1,0 +1,35 @@
+"""Model layer: layer vocabulary, containers, reference model builders."""
+
+from tpu_dist.models.layers import (
+    Activation,
+    AveragePooling2D,
+    BatchNormalization,
+    Conv2D,
+    Dense,
+    Dropout,
+    Flatten,
+    GlobalAveragePooling2D,
+    Layer,
+    MaxPooling2D,
+    ReLU,
+)
+from tpu_dist.models.model import Model, Sequential
+from tpu_dist.models.cnn import build_and_compile_cnn_model, build_cnn_model
+
+__all__ = [
+    "Activation",
+    "AveragePooling2D",
+    "BatchNormalization",
+    "Conv2D",
+    "Dense",
+    "Dropout",
+    "Flatten",
+    "GlobalAveragePooling2D",
+    "Layer",
+    "MaxPooling2D",
+    "ReLU",
+    "Model",
+    "Sequential",
+    "build_and_compile_cnn_model",
+    "build_cnn_model",
+]

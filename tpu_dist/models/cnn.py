@@ -1,0 +1,40 @@
+"""The reference MNIST CNN (SURVEY.md R5), as a tpu_dist model.
+
+Architecture (tf_dist_example.py:39-53; README.md:131-148):
+Conv2D(32, 3, relu) -> MaxPool -> Conv2D(64, 3, relu) -> MaxPool -> Flatten ->
+Dense(128, relu) -> Dense(10); compiled with
+SparseCategoricalCrossentropy(from_logits=True), SGD(lr=0.001),
+SparseCategoricalAccuracy.
+"""
+
+from __future__ import annotations
+
+from tpu_dist.models.layers import Conv2D, Dense, Flatten, MaxPooling2D
+from tpu_dist.models.model import Sequential
+from tpu_dist.ops.losses import SparseCategoricalCrossentropy
+from tpu_dist.ops.metrics import SparseCategoricalAccuracy
+from tpu_dist.ops.optimizers import SGD
+
+
+def build_cnn_model(num_classes: int = 10,
+                    input_shape: tuple = (28, 28, 1)) -> Sequential:
+    return Sequential([
+        Conv2D(32, 3, activation="relu"),
+        MaxPooling2D(),
+        Conv2D(64, 3, activation="relu"),
+        MaxPooling2D(),
+        Flatten(),
+        Dense(128, activation="relu"),
+        Dense(num_classes),
+    ], input_shape=input_shape, name="mnist_cnn")
+
+
+def build_and_compile_cnn_model(learning_rate: float = 0.001) -> Sequential:
+    """Line-for-line analog of tf_dist_example.py:39-53."""
+    model = build_cnn_model()
+    model.compile(
+        loss=SparseCategoricalCrossentropy(from_logits=True),
+        optimizer=SGD(learning_rate=learning_rate),
+        metrics=[SparseCategoricalAccuracy()],
+    )
+    return model

@@ -1,0 +1,303 @@
+"""Neural-net layers: pure-functional, shape-inferring, Keras-surface-compatible.
+
+Covers the layer vocabulary the reference model needs (SURVEY.md R5:
+Conv2D / MaxPooling2D / Flatten / Dense with relu activations,
+tf_dist_example.py:40-49) plus BatchNormalization / pooling / Dropout for the
+ResNet benchmark models (BASELINE.md configs 4-5).
+
+Design (the idiom shift from Keras, SURVEY.md D4/D17): a layer is an immutable
+*description*; parameters and mutable state (BatchNorm running stats) live in
+plain pytrees owned by the caller:
+
+    params, state, out_shape = layer.init(key, in_shape)   # shapes sans batch
+    y, new_state = layer.apply(params, state, x, training=True)
+
+Everything is jit-traceable; there are no Python-side variables to mirror —
+replication is a sharding annotation on the pytrees (tpu_dist.parallel.mesh).
+TPU notes: convs/matmuls use NHWC / HWIO layouts which XLA maps onto the MXU;
+``compute_dtype=bfloat16`` (via models.Policy) casts activations while keeping
+params and BN statistics in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.ops import initializers
+
+Params = Any
+State = Any
+Shape = tuple[int, ...]
+
+_ACTIVATIONS = {
+    None: lambda x: x,
+    "linear": lambda x: x,
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+}
+
+
+def _activation(name) -> Callable:
+    if callable(name):
+        return name
+    if name not in _ACTIVATIONS:
+        raise ValueError(
+            f"unknown activation {name!r}; available: "
+            f"{sorted(k for k in _ACTIVATIONS if k)}")
+    return _ACTIVATIONS[name]
+
+
+def _pair(v) -> tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    a, b = v
+    return (int(a), int(b))
+
+
+class Layer:
+    """Stateless layer description."""
+
+    def init(self, key, in_shape: Shape) -> tuple[Params, State, Shape]:
+        """Returns (params, state, out_shape); shapes exclude the batch dim."""
+        raise NotImplementedError
+
+    def apply(self, params: Params, state: State, x, *,
+              training: bool = False, rng=None) -> tuple[Any, State]:
+        raise NotImplementedError
+
+    @property
+    def kind(self) -> str:
+        return type(self).__name__.lower()
+
+    def __repr__(self):
+        fields = getattr(self, "__dataclass_fields__", {})
+        attrs = ", ".join(f"{k}={getattr(self, k)!r}" for k in fields)
+        return f"{type(self).__name__}({attrs})"
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Conv2D(Layer):
+    """2-D convolution, NHWC. Reference uses Conv2D(32|64, 3, relu)
+    (tf_dist_example.py:42, 44)."""
+
+    filters: int
+    kernel_size: int | tuple[int, int]
+    strides: int | tuple[int, int] = 1
+    padding: str = "valid"  # Keras Conv2D default
+    activation: Optional[str] = None
+    use_bias: bool = True
+    kernel_initializer: str = "glorot_uniform"
+
+    def init(self, key, in_shape):
+        h, w, cin = in_shape
+        kh, kw = _pair(self.kernel_size)
+        kernel = initializers.get(self.kernel_initializer)(
+            key, (kh, kw, cin, self.filters))
+        params = {"kernel": kernel}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.filters,), jnp.float32)
+        sh, sw = _pair(self.strides)
+        if self.padding.upper() == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return params, {}, (oh, ow, self.filters)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jax.lax.conv_general_dilated(
+            x, params["kernel"].astype(x.dtype),
+            window_strides=_pair(self.strides),
+            padding=self.padding.upper(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return _activation(self.activation)(y), state
+
+
+def _pool(x, window, strides, padding, init_val, op):
+    wh, ww = _pair(window)
+    sh, sw = _pair(strides)
+    return jax.lax.reduce_window(
+        x, init_val, op,
+        window_dimensions=(1, wh, ww, 1),
+        window_strides=(1, sh, sw, 1),
+        padding=padding.upper(),
+    )
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MaxPooling2D(Layer):
+    """Max pool — reference default pool_size=2 (tf_dist_example.py:43, 45)."""
+
+    pool_size: int | tuple[int, int] = 2
+    strides: Optional[int | tuple[int, int]] = None
+    padding: str = "valid"
+
+    def _strides(self):
+        return self.strides if self.strides is not None else self.pool_size
+
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        ph, pw = _pair(self.pool_size)
+        sh, sw = _pair(self._strides())
+        if self.padding.upper() == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - ph) // sh + 1, (w - pw) // sw + 1
+        return {}, {}, (oh, ow, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return _pool(x, self.pool_size, self._strides(), self.padding,
+                     -jnp.inf, jax.lax.max), state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class AveragePooling2D(MaxPooling2D):
+    def apply(self, params, state, x, *, training=False, rng=None):
+        summed = _pool(x, self.pool_size, self._strides(), self.padding,
+                       jnp.array(0, x.dtype), jax.lax.add)
+        if self.padding.upper() == "SAME":
+            # Keras averages over VALID window elements only — divide by the
+            # per-position count, not the full window size.
+            counts = _pool(jnp.ones(x.shape[1:3], x.dtype)[None, :, :, None],
+                           self.pool_size, self._strides(), self.padding,
+                           jnp.array(0, x.dtype), jax.lax.add)
+            return summed / counts, state
+        ph, pw = _pair(self.pool_size)
+        return summed / jnp.array(ph * pw, x.dtype), state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class GlobalAveragePooling2D(Layer):
+    def init(self, key, in_shape):
+        h, w, c = in_shape
+        return {}, {}, (c,)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.mean(x, axis=(1, 2)), state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Flatten(Layer):
+    """tf_dist_example.py:46."""
+
+    def init(self, key, in_shape):
+        return {}, {}, (math.prod(in_shape),)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x.reshape(x.shape[0], -1), state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Dense(Layer):
+    """Fully connected — reference uses Dense(128, relu) and Dense(10)
+    (tf_dist_example.py:47-48)."""
+
+    units: int
+    activation: Optional[str] = None
+    use_bias: bool = True
+    kernel_initializer: str = "glorot_uniform"
+
+    def init(self, key, in_shape):
+        (din,) = in_shape
+        params = {"kernel": initializers.get(self.kernel_initializer)(
+            key, (din, self.units))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.units,), jnp.float32)
+        return params, {}, (self.units,)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = x @ params["kernel"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(y.dtype)
+        return _activation(self.activation)(y), state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Activation(Layer):
+    activation: str = "relu"
+
+    def init(self, key, in_shape):
+        return {}, {}, in_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return _activation(self.activation)(x), state
+
+
+ReLU = lambda: Activation("relu")
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class BatchNormalization(Layer):
+    """Batch norm over the channel axis with running statistics.
+
+    Running mean/var live in ``state`` (float32 always); in a distributed step
+    the batch statistics are computed over the *global* batch automatically —
+    the batch axis is sharded, so XLA all-reduces the moment sums (sync-BN for
+    free; contrast TF where SyncBatchNormalization is a separate layer).
+    """
+
+    momentum: float = 0.99
+    epsilon: float = 1e-3
+    center: bool = True
+    scale: bool = True
+
+    def init(self, key, in_shape):
+        c = in_shape[-1]
+        params = {}
+        if self.scale:
+            params["gamma"] = jnp.ones((c,), jnp.float32)
+        if self.center:
+            params["beta"] = jnp.zeros((c,), jnp.float32)
+        state = {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)}
+        return params, state, in_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        reduce_axes = tuple(range(x.ndim - 1))
+        if training:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = jax.lax.rsqrt(var + self.epsilon)
+        y = (x.astype(jnp.float32) - mean) * inv
+        if self.scale:
+            y = y * params["gamma"]
+        if self.center:
+            y = y + params["beta"]
+        return y.astype(x.dtype), new_state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Dropout(Layer):
+    rate: float = 0.5
+
+    def init(self, key, in_shape):
+        if not 0.0 <= self.rate < 1.0:
+            raise ValueError(f"dropout rate must be in [0, 1), got {self.rate}")
+        return {}, {}, in_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        if not training or self.rate == 0.0:
+            return x, state
+        if rng is None:
+            raise ValueError("Dropout needs an rng during training; "
+                             "fit() threads one automatically")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), state

@@ -1,0 +1,208 @@
+"""Model containers: functional core + Keras-style compile/fit surface.
+
+Reproduces the high-level training API the reference script uses (SURVEY.md
+R5/R6, L5): ``Sequential([...])`` -> ``compile(loss, optimizer, metrics)`` ->
+``fit(dataset, epochs, steps_per_epoch)`` (tf_dist_example.py:39-59), so the
+reference example ports line-for-line. Underneath, a Model is two pure
+functions over pytrees —
+
+    variables = model.init(seed, input_shape)        # {'params':…, 'state':…}
+    logits, new_state = model.apply(variables['params'], variables['state'],
+                                    x, training=True, rng=key)
+
+— which is exactly what the jitted SPMD train step consumes. ``compile``
+captures the active strategy from the surrounding ``strategy.scope()``
+(tf_dist_example.py:56-57 semantics): under TF the scope intercepts variable
+creation; here it pins which mesh the variables will be replicated onto when
+``fit`` first touches them.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+from tpu_dist.models.layers import Layer
+from tpu_dist.ops import losses as losses_lib
+from tpu_dist.ops import metrics as metrics_lib
+from tpu_dist.ops import optimizers as optimizers_lib
+
+Variables = dict  # {'params': pytree, 'state': pytree}
+
+
+class Model:
+    """A named pair of (init_fn, apply_fn) plus compile/fit surface.
+
+    init_fn(key, input_shape) -> (params, state)
+    apply_fn(params, state, x, training, rng) -> (outputs, new_state)
+    """
+
+    def __init__(self, init_fn: Callable, apply_fn: Callable,
+                 input_shape: Optional[tuple] = None, name: str = "model"):
+        self._init_fn = init_fn
+        self._apply_fn = apply_fn
+        self.input_shape = input_shape
+        self.name = name
+        # Set by compile():
+        self.optimizer = None
+        self.loss = None
+        self.metrics: list = []
+        self.strategy = None
+        self._trainer = None
+        self._carryover: Optional[dict] = None  # weights across recompiles
+
+    # -- functional core -----------------------------------------------------
+
+    def init(self, seed: int | jax.Array = 0,
+             input_shape: Optional[tuple] = None) -> Variables:
+        shape = input_shape or self.input_shape
+        if shape is None:
+            raise ValueError(
+                f"{self.name}: input_shape unknown; pass it to init() or set "
+                "it on the model")
+        key = jax.random.PRNGKey(seed) if isinstance(seed, int) else seed
+        params, state = self._init_fn(key, tuple(shape))
+        return {"params": params, "state": state}
+
+    def apply(self, params, state, x, *, training: bool = False, rng=None):
+        return self._apply_fn(params, state, x, training, rng)
+
+    def __call__(self, variables: Variables, x, *, training: bool = False,
+                 rng=None):
+        out, _ = self.apply(variables["params"], variables["state"], x,
+                            training=training, rng=rng)
+        return out
+
+    # -- Keras-style training surface (SURVEY.md D15/D16) ---------------------
+
+    def compile(self, optimizer="sgd", loss=None, metrics=()) -> None:
+        """Record loss/optimizer/metrics and capture the scoped strategy
+        (tf_dist_example.py:50-53 surface)."""
+        from tpu_dist.parallel.strategy import get_strategy
+
+        self.optimizer = optimizers_lib.get(optimizer)
+        self.loss = losses_lib.get(loss) if loss is not None else None
+        self.metrics = [metrics_lib.get(m) for m in metrics]
+        self.strategy = get_strategy()
+        # Invalidate the jitted step but carry trained weights forward —
+        # recompiling must not reset a trained model (Keras fine-tuning
+        # workflow). Optimizer slots are re-created (shapes/algorithm may
+        # have changed).
+        if self._trainer is not None and self._trainer.variables is not None:
+            self._carryover = {
+                k: self._trainer.variables[k] for k in ("params", "state")}
+        self._trainer = None
+
+    def fit(self, x, epochs: int = 1, steps_per_epoch: Optional[int] = None,
+            verbose: int = 1, callbacks: Sequence = (), initial_epoch: int = 0,
+            seed: int = 0):
+        """Run the epoch/step loop (tf_dist_example.py:59 surface)."""
+        from tpu_dist.training.trainer import Trainer
+
+        if self.loss is None or self.optimizer is None:
+            raise RuntimeError(
+                f"{self.name} must be compile()d with a loss and optimizer "
+                "before fit()")
+        if self._trainer is None:
+            self._trainer = Trainer(self)
+        return self._trainer.fit(
+            x, epochs=epochs, steps_per_epoch=steps_per_epoch,
+            verbose=verbose, callbacks=callbacks, initial_epoch=initial_epoch,
+            seed=seed)
+
+    def evaluate(self, x, steps: Optional[int] = None, verbose: int = 1):
+        from tpu_dist.training.trainer import Trainer
+
+        if self._trainer is None:
+            self._trainer = Trainer(self)
+        return self._trainer.evaluate(x, steps=steps, verbose=verbose)
+
+    def predict(self, x):
+        from tpu_dist.training.trainer import Trainer
+
+        if self._trainer is None:
+            self._trainer = Trainer(self)
+        return self._trainer.predict(x)
+
+    @property
+    def variables(self) -> Optional[Variables]:
+        """Live training variables, once fit/evaluate has materialized them."""
+        return self._trainer.variables if self._trainer is not None else None
+
+    def save_weights(self, directory, step: int = 0):
+        """Chief-only checkpoint write (README.md:51 chief duty; §5.4)."""
+        from tpu_dist.training import checkpoint
+
+        return checkpoint.save(directory, self, step=step)
+
+    def load_weights(self, directory, step: Optional[int] = None) -> int:
+        """Restore training variables from the latest (or given) checkpoint."""
+        from tpu_dist.training import checkpoint
+
+        return checkpoint.restore_model(directory, self, step=step)
+
+
+class Sequential(Model):
+    """Linear layer stack — the reference model container
+    (tf_dist_example.py:40)."""
+
+    def __init__(self, layers: Sequence[Layer], *,
+                 input_shape: Optional[tuple] = None, name: str = "sequential"):
+        self.layers = list(layers)
+        if not self.layers:
+            raise ValueError("Sequential needs at least one layer")
+        self.layer_names = self._unique_names(self.layers)
+        super().__init__(self._init_layers, self._apply_layers,
+                         input_shape=input_shape, name=name)
+
+    @staticmethod
+    def _unique_names(layers: Sequence[Layer]) -> list[str]:
+        counts: collections.Counter = collections.Counter()
+        names = []
+        for layer in layers:
+            k = layer.kind
+            names.append(k if counts[k] == 0 else f"{k}_{counts[k]}")
+            counts[k] += 1
+        return names
+
+    def _init_layers(self, key, input_shape):
+        params: dict = {}
+        state: dict = {}
+        shape = tuple(input_shape)
+        keys = jax.random.split(key, len(self.layers))
+        for layer, name, k in zip(self.layers, self.layer_names, keys):
+            p, s, shape = layer.init(k, shape)
+            if p:
+                params[name] = p
+            if s:
+                state[name] = s
+        self.output_shape = shape
+        return params, state
+
+    def _apply_layers(self, params, state, x, training, rng):
+        new_state = dict(state) if state else {}
+        n_drop = sum(1 for l in self.layers if l.kind.startswith("dropout"))
+        drop_keys = (list(jax.random.split(rng, max(n_drop, 1)))
+                     if rng is not None else [])
+        di = 0
+        for layer, name in zip(self.layers, self.layer_names):
+            p = params.get(name, {})
+            s = state.get(name, {}) if state else {}
+            layer_rng = None
+            if layer.kind.startswith("dropout") and drop_keys:
+                layer_rng = drop_keys[di]
+                di += 1
+            x, s_new = layer.apply(p, s, x, training=training, rng=layer_rng)
+            if s_new:
+                new_state[name] = s_new
+        return x, new_state
+
+    def summary(self) -> str:
+        lines = [f'Model: "{self.name}"', "-" * 46]
+        for name, layer in zip(self.layer_names, self.layers):
+            lines.append(f"{name:<28}{type(layer).__name__}")
+        out = "\n".join(lines)
+        print(out)
+        return out

@@ -1,0 +1,29 @@
+"""Ops layer: initializers, losses, metrics, optimizers."""
+
+from tpu_dist.ops import initializers, losses, metrics, optimizers
+from tpu_dist.ops.losses import (
+    CategoricalCrossentropy,
+    Loss,
+    MeanSquaredError,
+    SparseCategoricalCrossentropy,
+)
+from tpu_dist.ops.metrics import Mean, Metric, SparseCategoricalAccuracy
+from tpu_dist.ops.optimizers import SGD, Adam, Optimizer, OptaxWrapper
+
+__all__ = [
+    "initializers",
+    "losses",
+    "metrics",
+    "optimizers",
+    "CategoricalCrossentropy",
+    "Loss",
+    "MeanSquaredError",
+    "SparseCategoricalCrossentropy",
+    "Mean",
+    "Metric",
+    "SparseCategoricalAccuracy",
+    "SGD",
+    "Adam",
+    "Optimizer",
+    "OptaxWrapper",
+]

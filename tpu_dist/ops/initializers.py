@@ -1,0 +1,63 @@
+"""Parameter initializers (Keras-default-compatible).
+
+The reference model relies on Keras layer defaults (tf_dist_example.py:39-53):
+glorot_uniform kernels + zero biases for Conv2D/Dense. He initializers are
+provided for the ResNet benchmark models (BASELINE.md configs 4-5).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: tuple[int, ...]) -> tuple[int, int]:
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # Conv kernels (H, W, Cin, Cout): receptive field x channels.
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    del key
+    return jnp.ones(shape, dtype)
+
+
+def glorot_uniform(key, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+def he_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    std = math.sqrt(2.0 / fan_in)
+    return std * jax.random.normal(key, shape, dtype)
+
+
+def uniform_scaled(key, shape, scale: float, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+
+def get(name: str):
+    table = {
+        "zeros": zeros,
+        "ones": ones,
+        "glorot_uniform": glorot_uniform,
+        "he_normal": he_normal,
+    }
+    if name not in table:
+        raise ValueError(f"unknown initializer {name!r}; available: {sorted(table)}")
+    return table[name]

@@ -1,0 +1,100 @@
+"""Loss functions.
+
+The reference compiles with ``SparseCategoricalCrossentropy(from_logits=True)``
+(tf_dist_example.py:50, README.md:144). Losses are pure functions returning the
+mean over the (local shard of the) batch; under the jitted SPMD step the mean
+over the global batch emerges from XLA's partitioning of the reduction, so the
+distributed loss equals the single-device loss of the concatenated batch
+(the §3.5 identical-loss invariant).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sparse_categorical_crossentropy(logits, labels, *, from_logits: bool = True):
+    """Per-example CE from integer labels: [B, C] x [B] -> [B]."""
+    if from_logits:
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        log_probs = jnp.log(jnp.clip(logits, 1e-7, 1.0))
+    return -jnp.take_along_axis(
+        log_probs, labels[..., None].astype(jnp.int32), axis=-1).squeeze(-1)
+
+
+def categorical_crossentropy(logits, onehot, *, from_logits: bool = True):
+    if from_logits:
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+    else:
+        log_probs = jnp.log(jnp.clip(logits, 1e-7, 1.0))
+    return -(onehot * log_probs).sum(axis=-1)
+
+
+def mean_squared_error(preds, targets):
+    return jnp.mean(jnp.square(preds - targets), axis=-1)
+
+
+class Loss:
+    """Callable loss object with a Keras-compatible constructor surface."""
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self.name = name
+
+    def __call__(self, logits, labels):
+        return jnp.mean(self._fn(logits, labels))
+
+    def per_example(self, logits, labels):
+        return self._fn(logits, labels)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class SparseCategoricalCrossentropy(Loss):
+    """tf.keras.losses.SparseCategoricalCrossentropy analog
+    (tf_dist_example.py:50)."""
+
+    def __init__(self, from_logits: bool = False):
+        super().__init__(
+            lambda logits, labels: sparse_categorical_crossentropy(
+                logits, labels, from_logits=from_logits),
+            "sparse_categorical_crossentropy")
+        self.from_logits = from_logits
+
+
+class CategoricalCrossentropy(Loss):
+    def __init__(self, from_logits: bool = False):
+        super().__init__(
+            lambda logits, onehot: categorical_crossentropy(
+                logits, onehot, from_logits=from_logits),
+            "categorical_crossentropy")
+        self.from_logits = from_logits
+
+
+class MeanSquaredError(Loss):
+    def __init__(self):
+        super().__init__(mean_squared_error, "mean_squared_error")
+
+
+def get(identifier) -> Loss:
+    if isinstance(identifier, Loss):
+        return identifier
+    # String identifiers resolve with from_logits=False, matching Keras's
+    # string-to-loss mapping — a model with a softmax head and
+    # loss="sparse_categorical_crossentropy" must compute the same loss it
+    # would under Keras. Logit-output models should pass the class with
+    # from_logits=True, exactly as the reference does (tf_dist_example.py:50).
+    table = {
+        "sparse_categorical_crossentropy":
+            lambda: SparseCategoricalCrossentropy(from_logits=False),
+        "categorical_crossentropy":
+            lambda: CategoricalCrossentropy(from_logits=False),
+        "mse": MeanSquaredError,
+        "mean_squared_error": MeanSquaredError,
+    }
+    if isinstance(identifier, str) and identifier in table:
+        return table[identifier]()
+    raise ValueError(f"unknown loss {identifier!r}; available: {sorted(table)}")

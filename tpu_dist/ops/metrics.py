@@ -1,0 +1,77 @@
+"""Metrics as replicated functional state.
+
+The reference compiles ``SparseCategoricalAccuracy`` and reports it (with the
+loss) per epoch (tf_dist_example.py:50-52). In TF, metric variables are
+mirrored under ``strategy.scope()`` and PerReplica results are reduced on the
+host (keras trainer ``reduce_per_replica``, SURVEY.md D15). TPU-native: a
+metric is a pytree of scalars living *inside* the jitted step — updates are
+pure functions, and because the batch reduction happens over the sharded
+global batch inside the SPMD program, cross-replica aggregation comes out of
+the compiler; the host only reads the final replicated scalars.
+
+Accumulation is (total, count) across steps — divided only at read time, so
+epoch metrics weight every sample equally like Keras's stateful metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+
+MetricState = Mapping[str, Any]
+
+
+class Metric:
+    name: str
+
+    def init(self) -> MetricState:
+        return {"total": jnp.zeros((), jnp.float32),
+                "count": jnp.zeros((), jnp.float32)}
+
+    def update(self, state: MetricState, logits, labels) -> MetricState:
+        raise NotImplementedError
+
+    def result(self, state: MetricState):
+        return state["total"] / jnp.maximum(state["count"], 1.0)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class SparseCategoricalAccuracy(Metric):
+    """tf.keras.metrics.SparseCategoricalAccuracy analog
+    (tf_dist_example.py:52)."""
+
+    def __init__(self, name: str = "accuracy"):
+        self.name = name
+
+    def update(self, state, logits, labels):
+        correct = (jnp.argmax(logits, axis=-1) == labels.astype(jnp.int32))
+        return {"total": state["total"] + correct.sum().astype(jnp.float32),
+                "count": state["count"] + jnp.float32(correct.size)}
+
+
+class Mean(Metric):
+    """Streaming mean — used for the loss channel of the progress bar."""
+
+    def __init__(self, name: str = "mean"):
+        self.name = name
+
+    def update(self, state, value, weight=None):
+        w = jnp.float32(1.0) if weight is None else jnp.float32(weight)
+        return {"total": state["total"] + jnp.asarray(value, jnp.float32) * w,
+                "count": state["count"] + w}
+
+
+def get(identifier) -> Metric:
+    if isinstance(identifier, Metric):
+        return identifier
+    table = {
+        "accuracy": lambda: SparseCategoricalAccuracy(),
+        "sparse_categorical_accuracy": lambda: SparseCategoricalAccuracy(
+            name="sparse_categorical_accuracy"),
+    }
+    if isinstance(identifier, str) and identifier in table:
+        return table[identifier]()
+    raise ValueError(f"unknown metric {identifier!r}; available: {sorted(table)}")

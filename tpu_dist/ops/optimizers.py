@@ -1,0 +1,127 @@
+"""Optimizers: pure functional update rules over parameter pytrees.
+
+The reference uses ``SGD(learning_rate=0.001)`` (tf_dist_example.py:51).
+Distributed semantics (SURVEY.md D16): TF all-reduces summed gradients in
+replica context and then applies per-variable updates under ``merge_call``
+(keras:src/backend/tensorflow/optimizer.py:113-160). TPU-native: gradients
+arriving here are already globally averaged — either implicitly (pjit autodiff
+of a mean over the sharded global batch forces an AllReduce, since params are
+replicated) or explicitly (``pmean`` in the shard_map step) — so an optimizer
+is just ``init(params) -> state`` and ``update(grads, state, params) ->
+(new_params, new_state)``, compiled into the same XLA program as the backward
+pass. Any optax ``GradientTransformation`` is also accepted (wrapped).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Optimizer:
+    def init(self, params) -> Any:
+        raise NotImplementedError
+
+    def update(self, grads, state, params) -> tuple[Any, Any]:
+        """Returns (new_params, new_state)."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        attrs = ", ".join(f"{k}={v}" for k, v in vars(self).items())
+        return f"{type(self).__name__}({attrs})"
+
+
+class SGD(Optimizer):
+    """SGD with optional momentum/nesterov — tf.keras SGD analog
+    (tf_dist_example.py:51 uses lr=0.001, no momentum)."""
+
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+                 nesterov: bool = False):
+        self.learning_rate = float(learning_rate)
+        self.momentum = float(momentum)
+        self.nesterov = bool(nesterov)
+
+    def init(self, params):
+        if self.momentum == 0.0:
+            return ()
+        return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def update(self, grads, state, params):
+        lr = self.learning_rate
+        if self.momentum == 0.0:
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, params, grads)
+            return new_params, state
+        m = self.momentum
+        new_vel = jax.tree_util.tree_map(
+            lambda v, g: m * v - lr * g, state, grads)
+        if self.nesterov:
+            new_params = jax.tree_util.tree_map(
+                lambda p, v, g: p + m * v - lr * g, params, new_vel, grads)
+        else:
+            new_params = jax.tree_util.tree_map(
+                lambda p, v: p + v, params, new_vel)
+        return new_params, new_vel
+
+
+class AdamState(NamedTuple):
+    step: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate: float = 0.001, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-7):
+        self.learning_rate = float(learning_rate)
+        self.beta_1 = float(beta_1)
+        self.beta_2 = float(beta_2)
+        self.epsilon = float(epsilon)
+
+    def init(self, params):
+        z = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return AdamState(step=jnp.zeros((), jnp.int32), mu=z(), nu=z())
+
+    def update(self, grads, state, params):
+        b1, b2, eps, lr = self.beta_1, self.beta_2, self.epsilon, self.learning_rate
+        step = state.step + 1
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
+        nu = jax.tree_util.tree_map(
+            lambda n, g: b2 * n + (1 - b2) * jnp.square(g), state.nu, grads)
+        t = step.astype(jnp.float32)
+        scale = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        new_params = jax.tree_util.tree_map(
+            lambda p, m, n: p - scale * m / (jnp.sqrt(n) + eps),
+            params, mu, nu)
+        return new_params, AdamState(step=step, mu=mu, nu=nu)
+
+
+class OptaxWrapper(Optimizer):
+    """Adapter accepting any optax GradientTransformation."""
+
+    def __init__(self, transform):
+        self.transform = transform
+
+    def init(self, params):
+        return self.transform.init(params)
+
+    def update(self, grads, state, params):
+        updates, new_state = self.transform.update(grads, state, params)
+        import optax
+
+        return optax.apply_updates(params, updates), new_state
+
+
+def get(identifier) -> Optimizer:
+    if isinstance(identifier, Optimizer):
+        return identifier
+    # Duck-type optax transforms.
+    if hasattr(identifier, "init") and hasattr(identifier, "update"):
+        return OptaxWrapper(identifier)
+    table = {"sgd": SGD, "adam": Adam}
+    if isinstance(identifier, str) and identifier.lower() in table:
+        return table[identifier.lower()]()
+    raise ValueError(f"unknown optimizer {identifier!r}; available: {sorted(table)}")

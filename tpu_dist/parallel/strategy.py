@@ -109,6 +109,15 @@ class Strategy:
         """Host batch pytree -> global device array, batch-dim sharded."""
         return mesh_lib.shard_batch(batch, self._mesh, self.data_axis)
 
+    def experimental_distribute_dataset(self, dataset, policy=None):
+        """Wrap a ``tpu_dist.data.Dataset`` for per-replica delivery — the
+        analog of the commented alternative at tf_dist_example.py:36. The
+        dataset should be batched to the global batch size; each process keeps
+        its shard per the dataset's auto-shard policy (SURVEY.md D14)."""
+        from tpu_dist.data.distribute import DistributedDataset
+
+        return DistributedDataset(dataset, self, policy=policy)
+
     def reduce(self, op: ReduceOp | str, value):
         """Host-side reduction of a per-replica value to a single result."""
         import jax.numpy as jnp

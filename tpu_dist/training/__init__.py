@@ -1,0 +1,23 @@
+"""Training layer: fit engine, callbacks, checkpointing."""
+
+from tpu_dist.training import checkpoint
+from tpu_dist.training.callbacks import (
+    Callback,
+    EarlyStopping,
+    History,
+    LambdaCallback,
+    ModelCheckpoint,
+    StopTraining,
+)
+from tpu_dist.training.trainer import Trainer
+
+__all__ = [
+    "checkpoint",
+    "Callback",
+    "EarlyStopping",
+    "History",
+    "LambdaCallback",
+    "ModelCheckpoint",
+    "StopTraining",
+    "Trainer",
+]

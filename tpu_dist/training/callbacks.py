@@ -1,0 +1,155 @@
+"""Training callbacks — the Keras-fit hook surface the reference relies on.
+
+The reference's fit loop runs callbacks/progress per step and epoch (SURVEY.md
+§3.3 hot path: "callbacks / progress (chief also checkpoints+TensorBoard per
+README.md:51)"). Implemented here: the base hook protocol, History (always
+installed, the object ``fit`` returns), ModelCheckpoint (chief-only writes per
+README.md:51), and EarlyStopping. Scope is intentionally the
+reference-exercised surface (SURVEY.md hard-part #2: avoid Keras scope creep).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+logger = logging.getLogger("tpu_dist.callbacks")
+
+
+class Callback:
+    model = None  # wired by CallbackList
+
+    def on_train_begin(self) -> None: ...
+    def on_train_end(self) -> None: ...
+    def on_epoch_begin(self, epoch: int) -> None: ...
+    def on_epoch_end(self, epoch: int, logs: dict) -> None: ...
+    def on_batch_end(self, step: int, logs: dict) -> None: ...
+
+    #: Set True on subclasses that implement on_batch_end, so the trainer only
+    #: pays the per-step device->host sync when someone is listening.
+    wants_batches = False
+
+
+class CallbackList:
+    def __init__(self, callbacks: Sequence[Callback], model=None):
+        self.callbacks = list(callbacks)
+        for cb in self.callbacks:
+            cb.model = model
+
+    @property
+    def has_batch_hooks(self) -> bool:
+        return any(cb.wants_batches for cb in self.callbacks)
+
+    def on_train_begin(self):
+        for cb in self.callbacks:
+            cb.on_train_begin()
+
+    def on_train_end(self):
+        for cb in self.callbacks:
+            cb.on_train_end()
+
+    def on_epoch_begin(self, epoch):
+        for cb in self.callbacks:
+            cb.on_epoch_begin(epoch)
+
+    def on_epoch_end(self, epoch, logs):
+        for cb in self.callbacks:
+            cb.on_epoch_end(epoch, logs)
+
+    def on_batch_end(self, step, logs):
+        for cb in self.callbacks:
+            if cb.wants_batches:
+                cb.on_batch_end(step, logs)
+
+
+class History(Callback):
+    """Per-epoch log record; ``fit`` returns this (Keras History analog)."""
+
+    def __init__(self):
+        self.history: dict[str, list] = {}
+        self.epoch: list[int] = []
+
+    def on_epoch_end(self, epoch, logs):
+        self.epoch.append(epoch)
+        for k, v in logs.items():
+            self.history.setdefault(k, []).append(v)
+
+
+class ModelCheckpoint(Callback):
+    """Chief-only checkpoint writes each epoch (README.md:51 semantics:
+    'the chief saves checkpoint models')."""
+
+    def __init__(self, directory: str, *, save_best_only: bool = False,
+                 monitor: str = "loss", mode: str = "min",
+                 max_to_keep: Optional[int] = None):
+        self.directory = directory
+        self.save_best_only = save_best_only
+        self.monitor = monitor
+        self.mode = mode
+        self.max_to_keep = max_to_keep
+        self._best: Optional[float] = None
+
+    def on_epoch_end(self, epoch, logs):
+        from tpu_dist.training import checkpoint
+
+        if self.save_best_only:
+            current = logs.get(self.monitor)
+            if current is None:
+                logger.warning("ModelCheckpoint: monitor %r not in logs %s",
+                               self.monitor, sorted(logs))
+                return
+            better = (self._best is None
+                      or (current < self._best if self.mode == "min"
+                          else current > self._best))
+            if not better:
+                return
+            self._best = current
+        checkpoint.save(self.directory, self.model, step=epoch,
+                        max_to_keep=self.max_to_keep)
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor: str = "loss", patience: int = 3,
+                 mode: str = "min", min_delta: float = 0.0):
+        self.monitor = monitor
+        self.patience = patience
+        self.mode = mode
+        self.min_delta = min_delta
+        self._best: Optional[float] = None
+        self._wait = 0
+        self.stopped_epoch: Optional[int] = None
+
+    def on_epoch_end(self, epoch, logs):
+        current = logs.get(self.monitor)
+        if current is None:
+            return
+        improved = (self._best is None or
+                    (self._best - current > self.min_delta if self.mode == "min"
+                     else current - self._best > self.min_delta))
+        if improved:
+            self._best = current
+            self._wait = 0
+        else:
+            self._wait += 1
+            if self._wait > self.patience:
+                self.stopped_epoch = epoch
+                raise StopTraining(f"EarlyStopping at epoch {epoch}")
+
+
+class StopTraining(Exception):
+    """Raised by callbacks to end fit cleanly."""
+
+
+class LambdaCallback(Callback):
+    def __init__(self, *, on_epoch_end=None, on_batch_end=None):
+        self._epoch_end = on_epoch_end
+        self._batch_end = on_batch_end
+        self.wants_batches = on_batch_end is not None
+
+    def on_epoch_end(self, epoch, logs):
+        if self._epoch_end:
+            self._epoch_end(epoch, logs)
+
+    def on_batch_end(self, step, logs):
+        if self._batch_end:
+            self._batch_end(step, logs)

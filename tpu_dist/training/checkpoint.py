@@ -1,0 +1,186 @@
+"""Checkpoint / resume: chief-writes, everyone-restores (SURVEY.md §5.4).
+
+The reference specifies the capability in prose only — the chief's duties
+include "saving checkpoint models" (README.md:51); the example itself never
+saves. Parity target: chief-only checkpoint + resume-from-latest, not a format
+zoo. Format: one ``.npz`` of flattened arrays + a JSON manifest per step,
+written atomically (temp + rename), with a ``checkpoint`` pointer file naming
+the latest step — restore on every process, then a broadcast from process 0
+guarantees bit-identical restored state cluster-wide (the D4 init-broadcast
+rule applied to resume; divergence-free restore is SURVEY.md hard-part #3).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pathlib
+import tempfile
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from tpu_dist.cluster import bootstrap
+
+logger = logging.getLogger("tpu_dist.checkpoint")
+
+_POINTER = "checkpoint"
+_MANIFEST = "manifest.json"
+_ARRAYS = "arrays.npz"
+
+
+def _flatten(tree) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten_into(template, arrays: dict[str, np.ndarray]):
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths_leaves:
+        key = jax.tree_util.keystr(path)
+        if key not in arrays:
+            raise KeyError(
+                f"checkpoint missing array {key!r}; checkpoint/model mismatch")
+        arr = arrays[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"checkpoint array {key!r} has shape {arr.shape}, model "
+                f"expects {np.shape(leaf)}")
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _step_dir(directory: pathlib.Path, step: int) -> pathlib.Path:
+    return directory / f"ckpt-{step}"
+
+
+def save(directory: str | os.PathLike, model_or_variables, *, step: int,
+         max_to_keep: Optional[int] = None) -> Optional[str]:
+    """Write checkpoint ``step``; returns its path (None on non-chief).
+
+    Accepts a compiled Model (saves its live training variables) or a raw
+    variables pytree. Only the chief writes (README.md:51); all processes
+    rendezvous afterwards so no peer races ahead of a half-written checkpoint.
+    """
+    variables = getattr(model_or_variables, "variables", model_or_variables)
+    if variables is None:
+        raise ValueError("model has no materialized variables to save; "
+                         "run fit() or ensure_variables() first")
+    saveable = {k: variables[k] for k in ("params", "state", "opt")
+                if k in variables}
+    directory = pathlib.Path(directory)
+    path = None
+    if bootstrap.is_chief():
+        directory.mkdir(parents=True, exist_ok=True)
+        target = _step_dir(directory, step)
+        flat = _flatten(saveable)
+        # Atomic publish: stage into a temp dir, then rename into place.
+        with tempfile.TemporaryDirectory(dir=directory) as tmp:
+            tmp_path = pathlib.Path(tmp) / "stage"
+            tmp_path.mkdir()
+            np.savez(tmp_path / _ARRAYS, **flat)
+            (tmp_path / _MANIFEST).write_text(json.dumps({
+                "step": step,
+                "keys": sorted(flat),
+                "format": "tpu_dist.checkpoint.v1",
+            }))
+            if target.exists():
+                import shutil
+
+                shutil.rmtree(target)
+            os.replace(tmp_path, target)
+        (directory / _POINTER).write_text(str(step))
+        path = str(target)
+        logger.info("checkpoint step %d written to %s", step, target)
+        if max_to_keep is not None:
+            _gc(directory, max_to_keep)
+    bootstrap.barrier(f"checkpoint_save_{step}")
+    return path
+
+
+def _gc(directory: pathlib.Path, max_to_keep: int) -> None:
+    steps = sorted(all_steps(directory))
+    for old in steps[:-max_to_keep]:
+        import shutil
+
+        shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
+
+
+def all_steps(directory: str | os.PathLike) -> list[int]:
+    directory = pathlib.Path(directory)
+    if not directory.is_dir():
+        return []
+    out = []
+    for child in directory.iterdir():
+        if child.is_dir() and child.name.startswith("ckpt-"):
+            try:
+                out.append(int(child.name.split("-", 1)[1]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def latest_step(directory: str | os.PathLike) -> Optional[int]:
+    directory = pathlib.Path(directory)
+    pointer = directory / _POINTER
+    if pointer.is_file():
+        try:
+            return int(pointer.read_text().strip())
+        except ValueError:
+            pass
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def restore(directory: str | os.PathLike, template: Any, *,
+            step: Optional[int] = None) -> tuple[Any, int]:
+    """Load checkpoint arrays into the structure of ``template``.
+
+    Returns (host variables pytree, step). Process 0's bytes are broadcast to
+    every process so the restored state is identical cluster-wide even if the
+    filesystem is not shared/consistent.
+    """
+    directory = pathlib.Path(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    target = _step_dir(directory, step)
+    host_template = jax.tree_util.tree_map(np.asarray, template)
+    if bootstrap.process_index() == 0:
+        with np.load(target / _ARRAYS) as z:
+            arrays = {k: z[k] for k in z.files}
+        restored = _unflatten_into(host_template, arrays)
+    else:
+        # Non-chief processes skip the (possibly shared-FS) read entirely;
+        # they receive process 0's bytes in the broadcast below.
+        restored = host_template
+    from tpu_dist.parallel.collectives import broadcast_from_chief
+
+    restored = broadcast_from_chief(restored)
+    logger.info("restored checkpoint step %d from %s", step, target)
+    return restored, step
+
+
+def restore_model(directory: str | os.PathLike, model, *,
+                  step: Optional[int] = None) -> int:
+    """Restore a compiled model's training variables in place (resume)."""
+    from tpu_dist.training.trainer import Trainer
+
+    if model._trainer is None:
+        model._trainer = Trainer(model)
+    trainer = model._trainer
+    trainer.ensure_variables()
+    v = trainer.variables
+    template = {k: v[k] for k in ("params", "state", "opt") if k in v}
+    host, step = restore(directory, template, step=step)
+    placed = trainer.strategy.replicate(host, broadcast=False)
+    for k in template:
+        v[k] = placed[k]
+    return step

@@ -1,0 +1,295 @@
+"""The training engine: jitted SPMD train step + Keras-2-style fit loop.
+
+Re-provides the Keras trainer + distributed optimizer path (SURVEY.md D15-D17,
+§3.3) the reference drives through ``model.fit(x=dataset, epochs=10,
+steps_per_epoch=20)`` (tf_dist_example.py:59). The idiom shift:
+
+TF reference                          | here
+--------------------------------------|------------------------------------
+tf.function traces the step once      | jax.jit compiles the WHOLE step (fwd,
+(graph, Grappler, per-op kernels)     | loss, bwd, all-reduce, update) into
+                                      | one XLA program — always compiled
+strategy.run + PerReplica values      | one global batch array, sharded on the
+                                      | mesh data axis; no per-replica values
+replica_context.all_reduce(SUM) on    | nothing explicit: params are
+grads (keras optimizer:151-160)       | replicated, batch is sharded, so the
+                                      | loss-mean's gradient REQUIRES a
+                                      | cross-replica sum — XLA's partitioner
+                                      | emits the AllReduce (over ICI/DCN) and
+                                      | overlaps it with compute
+merge_call per-variable updates       | optimizer update fused into the step
+PerReplica metric reduce on host      | metric state replicated in-program
+
+Because the loss is the mean over the *global* (sharded) batch and parameters
+are replicated, the distributed step is numerically identical to a
+single-device step over the concatenated batch — the reference's verified
+invariant (identical losses on every worker, SURVEY.md §3.5).
+
+Epoch semantics are Keras-2-era (SURVEY.md D15 era note): one persistent
+iterator across epochs when ``steps_per_epoch`` is set, re-created (fresh
+shuffle) on exhaustion.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from tpu_dist.cluster import bootstrap
+from tpu_dist.data.distribute import DistributedDataset
+from tpu_dist.data.pipeline import Dataset
+from tpu_dist.training.callbacks import CallbackList, History, StopTraining
+from tpu_dist.utils.progbar import ProgressBar
+
+logger = logging.getLogger("tpu_dist.trainer")
+
+
+class Trainer:
+    """Owns device-resident training variables and the compiled steps."""
+
+    def __init__(self, model):
+        from tpu_dist.parallel.strategy import get_strategy
+
+        self.model = model
+        self.strategy = model.strategy or get_strategy()
+        self.variables: Optional[dict] = None  # params/state/opt/metrics
+        self._train_step = None
+        self._eval_step = None
+        self._predict_fn = None
+        self._iterator = None
+        self._iterator_source = None
+
+    # -- variable materialization (D4: mirrored init, chief broadcast) -------
+
+    def ensure_variables(self, seed: int = 0) -> None:
+        if self.variables is not None:
+            return
+        carried = getattr(self.model, "_carryover", None)
+        if carried is not None:
+            # Weights survive a recompile (Keras semantics); optimizer slots
+            # are rebuilt for the (possibly new) optimizer.
+            self.model._carryover = None
+            host_params = jax.tree_util.tree_map(np.asarray, carried["params"])
+            host = {
+                "params": host_params,
+                "state": jax.tree_util.tree_map(np.asarray, carried["state"]),
+                "opt": self.model.optimizer.init(host_params)
+                if self.model.optimizer else (),
+            }
+        else:
+            model_vars = self.model.init(seed)
+            host = {
+                "params": model_vars["params"],
+                "state": model_vars["state"],
+                "opt": self.model.optimizer.init(model_vars["params"])
+                if self.model.optimizer else (),
+            }
+        # Replicate onto the mesh; multi-process jobs broadcast process 0's
+        # values so every replica starts identical (SURVEY.md D4, §3.2).
+        placed = self.strategy.replicate(host)
+        placed["metrics"] = self._init_metric_states()
+        self.variables = placed
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(
+            host["params"]))
+        logger.info("%s: materialized %d parameters on %d replica(s)",
+                    self.model.name, n_params, self.strategy.num_replicas_in_sync)
+
+    def _init_metric_states(self):
+        states = tuple(m.init() for m in self.model.metrics)
+        return self.strategy.replicate(states, broadcast=False)
+
+    # -- compiled steps -------------------------------------------------------
+
+    def _build_train_step(self):
+        model, loss_obj, optimizer = (self.model, self.model.loss,
+                                      self.model.optimizer)
+        metrics = tuple(model.metrics)
+        rep = self.strategy.param_sharding()
+
+        def step(params, state, opt_state, metric_states, x, y, rng):
+            def loss_fn(p):
+                logits, new_state = model.apply(p, state, x, training=True,
+                                                rng=rng)
+                return loss_obj(logits, y), (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = optimizer.update(grads, opt_state, params)
+            new_metrics = tuple(
+                m.update(ms, logits, y) for m, ms in zip(metrics, metric_states))
+            return loss, new_params, new_state, new_opt, new_metrics
+
+        def rep_like(tree):
+            return jax.tree_util.tree_map(lambda _: rep, tree)
+
+        v = self.variables
+        return jax.jit(
+            step,
+            out_shardings=(None, rep_like(v["params"]), rep_like(v["state"]),
+                           rep_like(v["opt"]), rep_like(v["metrics"])),
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+    def _build_eval_step(self):
+        model, loss_obj = self.model, self.model.loss
+        metrics = tuple(model.metrics)
+
+        def step(params, state, metric_states, loss_acc, x, y):
+            logits, _ = model.apply(params, state, x, training=False)
+            loss = loss_obj(logits, y)
+            new_metrics = tuple(
+                m.update(ms, logits, y) for m, ms in zip(metrics, metric_states))
+            new_loss_acc = (loss_acc[0] + loss, loss_acc[1] + 1.0)
+            return new_metrics, new_loss_acc
+
+        return jax.jit(step, donate_argnums=(2, 3))
+
+    # -- data plumbing (D14/D15 auto-wrap) ------------------------------------
+
+    def _distribute(self, x) -> DistributedDataset:
+        if isinstance(x, DistributedDataset):
+            return x
+        if isinstance(x, Dataset):
+            # The Keras-trainer auto-wrap (keras:src/backend/tensorflow/
+            # trainer.py:750-755): honors the dataset's auto-shard options.
+            return DistributedDataset(x, self.strategy)
+        if isinstance(x, (tuple, list)) and len(x) == 2:
+            ds = Dataset.from_tensor_slices(tuple(np.asarray(a) for a in x))
+            return DistributedDataset(ds.batch(32), self.strategy)
+        raise TypeError(
+            f"fit/evaluate expects a Dataset, DistributedDataset or (x, y) "
+            f"arrays; got {type(x).__name__}")
+
+    def _next_batch(self, dist: DistributedDataset):
+        """Persistent-iterator semantics across epochs (Keras 2): re-create on
+        exhaustion — a fresh pass implies a fresh (re)shuffle."""
+        if self._iterator is None or self._iterator_source is not dist:
+            self._iterator = iter(dist)
+            self._iterator_source = dist
+        try:
+            return next(self._iterator)
+        except StopIteration:
+            self._iterator = iter(dist)
+            batch = next(self._iterator, None)
+            if batch is None:
+                raise RuntimeError("dataset yielded no batches")
+            return batch
+
+    # -- fit / evaluate / predict ---------------------------------------------
+
+    def fit(self, x, *, epochs: int, steps_per_epoch: Optional[int],
+            verbose: int, callbacks: Sequence, initial_epoch: int,
+            seed: int) -> History:
+        self.ensure_variables(seed)
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        dist = self._distribute(x)
+        if steps_per_epoch is None:
+            steps_per_epoch = dist._local.cardinality()
+            if steps_per_epoch is None:
+                raise ValueError(
+                    "steps_per_epoch is required for datasets of unknown "
+                    "cardinality (e.g. repeated/generator datasets)")
+
+        history = History()
+        cbs = CallbackList([history, *callbacks], model=self.model)
+        chief = bootstrap.is_chief()
+        show = verbose and chief
+        root_key = jax.random.PRNGKey(seed ^ 0x5EED)
+
+        cbs.on_train_begin()
+        try:
+            self._run_epochs(dist, cbs, initial_epoch, epochs, steps_per_epoch,
+                             show, root_key)
+        except StopTraining as e:
+            logger.info("training stopped early: %s", e)
+        cbs.on_train_end()
+        return history
+
+    def _run_epochs(self, dist, cbs, initial_epoch, epochs, steps_per_epoch,
+                    show, root_key):
+        for epoch in range(initial_epoch, epochs):
+            cbs.on_epoch_begin(epoch)
+            if show:
+                print(f"Epoch {epoch + 1}/{epochs}")
+            bar = ProgressBar(steps_per_epoch, enabled=bool(show))
+            v = self.variables
+            v["metrics"] = self._init_metric_states()  # reset per epoch
+            # Per-step host sync (float(loss)) is only paid when something
+            # consumes it — otherwise steps stay fully async on device and the
+            # host runs ahead filling the dispatch pipeline (BASELINE.md
+            # hard-part #5: tiny MNIST steps are dispatch-bound).
+            eager_loss = bool(show) or cbs.has_batch_hooks
+            loss = None
+            t_epoch = time.perf_counter()
+            for step_i in range(steps_per_epoch):
+                xb, yb = self._next_batch(dist)
+                rng = jax.random.fold_in(root_key, epoch * 100003 + step_i)
+                loss, v["params"], v["state"], v["opt"], v["metrics"] = (
+                    self._train_step(v["params"], v["state"], v["opt"],
+                                     v["metrics"], xb, yb, rng))
+                if eager_loss:
+                    loss_val = float(loss)
+                    bar.update(step_i + 1, loss=loss_val)
+                    cbs.on_batch_end(step_i, {"loss": loss_val})
+            logs = {"loss": float(loss),
+                    "epoch_time": time.perf_counter() - t_epoch}
+            for metric, mstate in zip(self.model.metrics, v["metrics"]):
+                logs[metric.name] = float(metric.result(mstate))
+            bar.finish(logs)
+            cbs.on_epoch_end(epoch, logs)
+
+    def evaluate(self, x, *, steps: Optional[int], verbose: int) -> dict:
+        self.ensure_variables()
+        if self._eval_step is None:
+            self._eval_step = self._build_eval_step()
+        dist = self._distribute(x)
+        v = self.variables
+        metric_states = self._init_metric_states()
+        loss_acc = self.strategy.replicate(
+            (np.float32(0.0), np.float32(0.0)), broadcast=False)
+        count = 0
+        for batch in dist:
+            if steps is not None and count >= steps:
+                break
+            xb, yb = batch
+            metric_states, loss_acc = self._eval_step(
+                v["params"], v["state"], metric_states, loss_acc, xb, yb)
+            count += 1
+        if count == 0:
+            raise RuntimeError("evaluate: dataset yielded no batches")
+        logs = {"loss": float(loss_acc[0]) / max(float(loss_acc[1]), 1.0)}
+        for metric, mstate in zip(self.model.metrics, metric_states):
+            logs[metric.name] = float(metric.result(mstate))
+        if verbose and bootstrap.is_chief():
+            print(" - ".join(f"{k}: {v_:.4f}" for k, v_ in logs.items()))
+        return logs
+
+    def predict(self, x):
+        self.ensure_variables()
+        model = self.model
+        if self._predict_fn is None:
+            self._predict_fn = jax.jit(
+                lambda p, s, xb: model.apply(p, s, xb, training=False)[0])
+        if isinstance(x, np.ndarray) or hasattr(x, "__array__"):
+            batches = [np.asarray(x)]
+        else:
+            batches = [b[0] if isinstance(b, tuple) else b for b in x]
+        v = self.variables
+        n_dev = len(self.strategy.mesh.local_devices)
+        outs = []
+        for xb in batches:
+            xb = np.asarray(xb)
+            # Pad to a device multiple for even sharding, trim after.
+            n = xb.shape[0]
+            pad = (-n) % n_dev
+            if pad:
+                xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
+            placed = self.strategy.distribute_batch(xb)
+            out = np.asarray(self._predict_fn(v["params"], v["state"], placed))
+            outs.append(out[:n])
+        return np.concatenate(outs, axis=0)

@@ -1,0 +1,5 @@
+"""Utilities: progress bar, profiling, structured logging."""
+
+from tpu_dist.utils.progbar import ProgressBar
+
+__all__ = ["ProgressBar"]
