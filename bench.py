@@ -1,0 +1,162 @@
+"""Benchmark harness: the reference's headline workload, TPU-native.
+
+Workload (BASELINE.md): the reference's MNIST 2-conv CNN, global batch 128,
+SGD lr=0.001 (tf_dist_example.py:17-18, 51) — trained with the jitted SPMD
+step over a data-parallel mesh of every available device. Prints ONE JSON line:
+
+    {"metric": "mnist_cnn_images_per_sec_per_core", "value": N,
+     "unit": "images/sec/core", "vs_baseline": R}
+
+``vs_baseline`` is relative to the survey's indicative measurement of the
+reference (no numbers are published by the reference itself — BASELINE.md):
+~62 ms/step at global batch 128 across 2 CPU workers, i.e. ~1032
+images/sec/core (SURVEY.md §3.5, §6).
+
+Extra configs (BASELINE.md table) are selectable:
+    python bench.py [mnist_cnn|resnet18|resnet50] [--steps N] [--batch N]
+Only the default config prints the driver JSON line on stdout; others report
+to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+# Indicative reference throughput (images/sec/core), SURVEY.md §3.5/§6:
+# global batch 128 / 62 ms/step / 2 workers (1 device each).
+BASELINE_IMG_PER_SEC_PER_CORE = 128 / 0.062 / 2
+
+CONFIGS = {
+    # name: (dataset, model builder name, image shape, default global batch)
+    "mnist_cnn": ("mnist", "cnn", (28, 28, 1), 128),
+    "resnet18": ("fashion_mnist", "resnet18", (28, 28, 1), 256),
+    "resnet50": ("cifar10", "resnet50", (32, 32, 3), 256),
+}
+
+
+def build_model(kind: str, input_shape, num_classes: int = 10):
+    from tpu_dist.ops.losses import SparseCategoricalCrossentropy
+    from tpu_dist.ops.metrics import SparseCategoricalAccuracy
+    from tpu_dist.ops.optimizers import SGD
+
+    if kind == "cnn":
+        from tpu_dist.models.cnn import build_cnn_model
+
+        model = build_cnn_model(num_classes=num_classes,
+                                input_shape=input_shape)
+    else:
+        from tpu_dist.models import resnet
+
+        model = {"resnet18": resnet.ResNet18,
+                 "resnet50": resnet.ResNet50}[kind](
+            num_classes=num_classes, input_shape=input_shape)
+    model.compile(
+        loss=SparseCategoricalCrossentropy(from_logits=True),
+        optimizer=SGD(learning_rate=0.001),
+        metrics=[SparseCategoricalAccuracy()],
+    )
+    return model
+
+
+def run(config: str, steps: int, warmup: int, global_batch: int | None) -> dict:
+    import jax
+
+    from tpu_dist.parallel.strategy import MirroredStrategy
+
+    dataset_name, kind, shape, default_batch = CONFIGS[config]
+    global_batch = global_batch or default_batch
+
+    strategy = MirroredStrategy()
+    n_dev = strategy.num_replicas_in_sync
+    if global_batch % n_dev:
+        global_batch += n_dev - global_batch % n_dev
+
+    with strategy.scope():
+        model = build_model(kind, shape)
+
+    trainer_mod = __import__("tpu_dist.training.trainer",
+                             fromlist=["Trainer"])
+    trainer = trainer_mod.Trainer(model)
+    model._trainer = trainer
+    trainer.ensure_variables(seed=0)
+    train_step = trainer._build_train_step()
+
+    # Device-resident batches, pre-sharded: the benchmark measures the compiled
+    # step (fwd+loss+bwd+allreduce+update), with input delivery off the timed
+    # path — matching how the reference's steady-state step time was read
+    # (cached tf.data pipeline, SURVEY.md §3.4).
+    rng = np.random.default_rng(0)
+    x = (rng.integers(0, 256, size=(global_batch, *shape)) / 255.0
+         ).astype(np.float32)
+    y = rng.integers(0, 10, size=(global_batch,)).astype(np.int64)
+    xb = strategy.distribute_batch(x)
+    yb = strategy.distribute_batch(y)
+
+    v = trainer.variables
+    key = jax.random.PRNGKey(0)
+    state = (v["params"], v["state"], v["opt"], v["metrics"],
+             trainer._init_loss_acc())
+
+    def one_step(state, i):
+        loss, p, s, o, m, acc = train_step(*state, xb, yb,
+                                           jax.random.fold_in(key, i))
+        return loss, (p, s, o, m, acc)
+
+    for i in range(warmup):
+        loss, state = one_step(state, i)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(warmup, warmup + steps):
+        loss, state = one_step(state, i)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    step_ms = elapsed / steps * 1e3
+    img_per_sec = global_batch * steps / elapsed
+    img_per_sec_per_core = img_per_sec / n_dev
+    return {
+        "config": config,
+        "devices": n_dev,
+        "platform": jax.devices()[0].platform,
+        "global_batch": global_batch,
+        "steps": steps,
+        "step_ms": round(step_ms, 4),
+        "images_per_sec": round(img_per_sec, 1),
+        "images_per_sec_per_core": round(img_per_sec_per_core, 1),
+        "final_loss": float(jax.device_get(loss)),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("config", nargs="?", default="mnist_cnn",
+                        choices=sorted(CONFIGS))
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--warmup", type=int, default=20)
+    parser.add_argument("--batch", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    result = run(args.config, args.steps, args.warmup, args.batch)
+    print(json.dumps(result), file=sys.stderr)
+
+    if args.config == "mnist_cnn":
+        line = {
+            "metric": "mnist_cnn_images_per_sec_per_core",
+            "value": result["images_per_sec_per_core"],
+            "unit": "images/sec/core",
+            "vs_baseline": round(
+                result["images_per_sec_per_core"]
+                / BASELINE_IMG_PER_SEC_PER_CORE, 3),
+        }
+        print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -102,6 +102,8 @@ class TestModelCheckpointCallback:
         s = td.MirroredStrategy()
         with s.scope():
             model = _model(lr=0.0)  # loss never improves after epoch 0
-        model.fit(_ds(), epochs=3, steps_per_epoch=2, verbose=0,
+        # Full pass per epoch so every epoch sees the same batches and the
+        # epoch-mean loss is bit-identical (lr=0) — only epoch 0 may save.
+        model.fit(_ds(), epochs=3, steps_per_epoch=4, verbose=0,
                   callbacks=[ModelCheckpoint(tmp_path, save_best_only=True)])
         assert len(checkpoint.all_steps(tmp_path)) == 1

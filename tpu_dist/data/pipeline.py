@@ -253,7 +253,9 @@ class Dataset:
         def factory():
             yield from itertools.islice(self._it_factory(), count)
 
-        card = count if self._cardinality is None else min(count, self._cardinality)
+        # Unknown source cardinality stays unknown: the source may yield fewer
+        # than ``count`` elements (tf.data likewise keeps UNKNOWN_CARDINALITY).
+        card = None if self._cardinality is None else min(count, self._cardinality)
         return self._derive(factory, cardinality=card)
 
     def shard(self, num_shards: int, index: int) -> "Dataset":

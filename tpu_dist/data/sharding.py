@@ -35,9 +35,11 @@ def resolve_policy(dataset: Dataset, num_shards: int,
     if policy == AutoShardPolicy.HINT:
         return AutoShardPolicy.DATA
     if policy == AutoShardPolicy.AUTO:
-        if dataset.num_files >= num_shards > 1:
-            return AutoShardPolicy.FILE
-        if num_shards > 1:
+        # FILE needs a file-backed source, which in-memory pipelines don't
+        # have yet — AUTO must always yield a working sharding, so it resolves
+        # to DATA unconditionally (TF's own AUTO falls back to DATA when file
+        # sharding isn't applicable).
+        if num_shards > 1 and dataset.num_files < num_shards:
             logger.warning(
                 "AutoShardPolicy.AUTO: source has %d file(s) < %d workers; "
                 "falling back to DATA sharding", dataset.num_files, num_shards)

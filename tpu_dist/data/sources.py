@@ -21,6 +21,7 @@ import logging
 import os
 import pathlib
 import struct
+import zlib
 from typing import Mapping
 
 import numpy as np
@@ -112,7 +113,9 @@ def _synthetic(name: str, split: str, size: int | None) -> tuple[np.ndarray, np.
     full stream' semantics (README.md:113-120)."""
     shape, num_classes, _ = _SPECS[name]
     n = size or _SYNTHETIC_SIZES[split]
-    seed = abs(hash((name, split))) % (2**31)
+    # Stable across processes and runs (Python's hash() is salted per process,
+    # which would give every worker a different dataset).
+    seed = zlib.crc32(f"{name}/{split}".encode()) % (2**31)
     rng = np.random.default_rng(seed)
     h, w, c = shape
     yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)

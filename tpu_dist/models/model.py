@@ -115,6 +115,10 @@ class Model:
     def evaluate(self, x, steps: Optional[int] = None, verbose: int = 1):
         from tpu_dist.training.trainer import Trainer
 
+        if self.loss is None:
+            raise RuntimeError(
+                f"{self.name} must be compile()d with a loss before "
+                "evaluate()")
         if self._trainer is None:
             self._trainer = Trainer(self)
         return self._trainer.evaluate(x, steps=steps, verbose=verbose)

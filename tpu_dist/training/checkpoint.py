@@ -148,7 +148,19 @@ def restore(directory: str | os.PathLike, template: Any, *,
     """
     directory = pathlib.Path(directory)
     if step is None:
-        step = latest_step(directory)
+        # Resolve on process 0 and broadcast the choice: checkpoints are
+        # chief-written, so peers may have no local copy (or, on an
+        # eventually-consistent shared FS, see a different latest step).
+        if jax.process_count() > 1:
+            from tpu_dist.parallel.collectives import broadcast_from_chief
+
+            local = latest_step(directory) if bootstrap.process_index() == 0 \
+                else None
+            chosen = int(broadcast_from_chief(
+                np.int64(-1 if local is None else local)))
+            step = None if chosen < 0 else chosen
+        else:
+            step = latest_step(directory)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     target = _step_dir(directory, step)

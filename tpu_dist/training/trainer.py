@@ -102,6 +102,10 @@ class Trainer:
         states = tuple(m.init() for m in self.model.metrics)
         return self.strategy.replicate(states, broadcast=False)
 
+    def _init_loss_acc(self):
+        return self.strategy.replicate(
+            (np.float32(0.0), np.float32(0.0)), broadcast=False)
+
     # -- compiled steps -------------------------------------------------------
 
     def _build_train_step(self):
@@ -110,7 +114,7 @@ class Trainer:
         metrics = tuple(model.metrics)
         rep = self.strategy.param_sharding()
 
-        def step(params, state, opt_state, metric_states, x, y, rng):
+        def step(params, state, opt_state, metric_states, loss_acc, x, y, rng):
             def loss_fn(p):
                 logits, new_state = model.apply(p, state, x, training=True,
                                                 rng=rng)
@@ -121,17 +125,24 @@ class Trainer:
             new_params, new_opt = optimizer.update(grads, opt_state, params)
             new_metrics = tuple(
                 m.update(ms, logits, y) for m, ms in zip(metrics, metric_states))
-            return loss, new_params, new_state, new_opt, new_metrics
+            # Device-side epoch-loss accumulator — the epoch 'loss' reported to
+            # History/callbacks is the epoch mean (Keras semantics), not the
+            # final batch's sample, and accumulating on device keeps the hot
+            # loop free of host syncs.
+            new_acc = (loss_acc[0] + loss, loss_acc[1] + 1.0)
+            return loss, new_params, new_state, new_opt, new_metrics, new_acc
 
         def rep_like(tree):
             return jax.tree_util.tree_map(lambda _: rep, tree)
 
         v = self.variables
+        acc = self._init_loss_acc()
         return jax.jit(
             step,
             out_shardings=(None, rep_like(v["params"]), rep_like(v["state"]),
-                           rep_like(v["opt"]), rep_like(v["metrics"])),
-            donate_argnums=(0, 1, 2, 3),
+                           rep_like(v["opt"]), rep_like(v["metrics"]),
+                           rep_like(acc)),
+            donate_argnums=(0, 1, 2, 3, 4),
         )
 
     def _build_eval_step(self):
@@ -219,24 +230,27 @@ class Trainer:
             bar = ProgressBar(steps_per_epoch, enabled=bool(show))
             v = self.variables
             v["metrics"] = self._init_metric_states()  # reset per epoch
+            loss_acc = self._init_loss_acc()
             # Per-step host sync (float(loss)) is only paid when something
             # consumes it — otherwise steps stay fully async on device and the
             # host runs ahead filling the dispatch pipeline (BASELINE.md
             # hard-part #5: tiny MNIST steps are dispatch-bound).
             eager_loss = bool(show) or cbs.has_batch_hooks
-            loss = None
+            loss_running = 0.0
             t_epoch = time.perf_counter()
             for step_i in range(steps_per_epoch):
                 xb, yb = self._next_batch(dist)
                 rng = jax.random.fold_in(root_key, epoch * 100003 + step_i)
-                loss, v["params"], v["state"], v["opt"], v["metrics"] = (
-                    self._train_step(v["params"], v["state"], v["opt"],
-                                     v["metrics"], xb, yb, rng))
+                (loss, v["params"], v["state"], v["opt"], v["metrics"],
+                 loss_acc) = self._train_step(v["params"], v["state"], v["opt"],
+                                              v["metrics"], loss_acc, xb, yb,
+                                              rng)
                 if eager_loss:
                     loss_val = float(loss)
-                    bar.update(step_i + 1, loss=loss_val)
+                    loss_running += loss_val
+                    bar.update(step_i + 1, loss=loss_running / (step_i + 1))
                     cbs.on_batch_end(step_i, {"loss": loss_val})
-            logs = {"loss": float(loss),
+            logs = {"loss": float(loss_acc[0]) / max(float(loss_acc[1]), 1.0),
                     "epoch_time": time.perf_counter() - t_epoch}
             for metric, mstate in zip(self.model.metrics, v["metrics"]):
                 logs[metric.name] = float(metric.result(mstate))
