@@ -63,6 +63,21 @@ def build_model(kind: str, input_shape, num_classes: int = 10):
     return model
 
 
+def load_batch(dataset_name: str, shape, global_batch: int):
+    """One global batch from the named dataset (local files if present, else
+    the deterministic synthetic fallback — tpu_dist.data.sources)."""
+    from tpu_dist.data.sources import load_arrays
+
+    x_all, y_all = load_arrays(dataset_name, "train")
+    reps = -(-global_batch // len(x_all))
+    if reps > 1:
+        x_all, y_all = np.tile(x_all, (reps, 1, 1, 1)), np.tile(y_all, reps)
+    x = (x_all[:global_batch].reshape(global_batch, *shape)
+         .astype(np.float32) / 255.0)
+    y = y_all[:global_batch].astype(np.int64)
+    return x, y
+
+
 def run(config: str, steps: int, warmup: int, global_batch: int | None) -> dict:
     import jax
 
@@ -79,10 +94,9 @@ def run(config: str, steps: int, warmup: int, global_batch: int | None) -> dict:
     with strategy.scope():
         model = build_model(kind, shape)
 
-    trainer_mod = __import__("tpu_dist.training.trainer",
-                             fromlist=["Trainer"])
-    trainer = trainer_mod.Trainer(model)
-    model._trainer = trainer
+    from tpu_dist.training.trainer import Trainer
+
+    trainer = Trainer(model)
     trainer.ensure_variables(seed=0)
     train_step = trainer._build_train_step()
 
@@ -90,31 +104,32 @@ def run(config: str, steps: int, warmup: int, global_batch: int | None) -> dict:
     # step (fwd+loss+bwd+allreduce+update), with input delivery off the timed
     # path — matching how the reference's steady-state step time was read
     # (cached tf.data pipeline, SURVEY.md §3.4).
-    rng = np.random.default_rng(0)
-    x = (rng.integers(0, 256, size=(global_batch, *shape)) / 255.0
-         ).astype(np.float32)
-    y = rng.integers(0, 10, size=(global_batch,)).astype(np.int64)
+    x, y = load_batch(dataset_name, shape, global_batch)
     xb = strategy.distribute_batch(x)
     yb = strategy.distribute_batch(y)
 
     v = trainer.variables
     key = jax.random.PRNGKey(0)
+    # Per-step keys precomputed off the timed path — fold_in is an eager
+    # device op whose dispatch would otherwise pollute the dispatch-bound
+    # step-time measurement.
+    keys = [jax.random.fold_in(key, i) for i in range(warmup + steps)]
     state = (v["params"], v["state"], v["opt"], v["metrics"],
              trainer._init_loss_acc())
 
     def one_step(state, i):
-        loss, p, s, o, m, acc = train_step(*state, xb, yb,
-                                           jax.random.fold_in(key, i))
+        loss, p, s, o, m, acc = train_step(*state, xb, yb, keys[i])
         return loss, (p, s, o, m, acc)
 
+    loss = None
     for i in range(warmup):
         loss, state = one_step(state, i)
-    jax.block_until_ready(loss)
+    jax.block_until_ready((loss, state))
 
     t0 = time.perf_counter()
     for i in range(warmup, warmup + steps):
         loss, state = one_step(state, i)
-    jax.block_until_ready(loss)
+    jax.block_until_ready((loss, state))
     elapsed = time.perf_counter() - t0
 
     step_ms = elapsed / steps * 1e3

@@ -1,0 +1,154 @@
+"""ResNet benchmark models (BASELINE.md configs 4-5) + mixed-precision policy."""
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.models import ResNet18, ResNet50, set_policy
+from tpu_dist.models.layers import (
+    Activation, BatchNormalization, Block, Conv2D, Dense, Flatten, Residual,
+)
+from tpu_dist.ops import SGD, SparseCategoricalCrossentropy
+
+
+class TestContainers:
+    def test_block_chains_layers(self):
+        import jax
+
+        blk = Block(layers=(Conv2D(4, 3, padding="same"),
+                            BatchNormalization(), Activation("relu")))
+        p, s, out = blk.init(jax.random.PRNGKey(0), (8, 8, 3))
+        assert out == (8, 8, 4)
+        x = np.ones((2, 8, 8, 3), np.float32)
+        y, new_s = blk.apply(p, s, x, training=True)
+        assert y.shape == (2, 8, 8, 4)
+        assert "batchnormalization" in new_s
+
+    def test_residual_identity_shortcut(self):
+        import jax
+
+        res = Residual(main=(Conv2D(3, 3, padding="same", use_bias=False),
+                             BatchNormalization()))
+        p, s, out = res.init(jax.random.PRNGKey(0), (8, 8, 3))
+        assert out == (8, 8, 3)
+        assert "shortcut" not in p
+        x = np.random.default_rng(0).normal(size=(2, 8, 8, 3)).astype(np.float32)
+        y, _ = res.apply(p, s, x, training=False)
+        assert y.shape == x.shape
+
+    def test_residual_projection_shortcut(self):
+        import jax
+
+        res = Residual(
+            main=(Conv2D(8, 3, strides=2, padding="same", use_bias=False),
+                  BatchNormalization()),
+            shortcut=(Conv2D(8, 1, strides=2, padding="same", use_bias=False),
+                      BatchNormalization()))
+        p, s, out = res.init(jax.random.PRNGKey(0), (8, 8, 3))
+        assert out == (4, 4, 8)
+        assert "shortcut" in p
+
+    def test_residual_shape_mismatch_raises(self):
+        import jax
+
+        res = Residual(main=(Conv2D(8, 3, padding="same"),))  # 3->8 channels
+        with pytest.raises(ValueError, match="disagree"):
+            res.init(jax.random.PRNGKey(0), (8, 8, 3))
+
+
+class TestResNet:
+    @pytest.mark.parametrize("builder,shape", [
+        (ResNet18, (28, 28, 1)),   # Fashion-MNIST config
+        (ResNet18, (32, 32, 3)),
+    ])
+    def test_forward_shapes(self, builder, shape):
+        model = builder(num_classes=10, input_shape=shape)
+        v = model.init(0)
+        x = np.zeros((2, *shape), np.float32)
+        logits, state = model.apply(v["params"], v["state"], x, training=True)
+        assert logits.shape == (2, 10)
+        assert logits.dtype == np.float32
+
+    def test_resnet18_param_count(self):
+        # Canonical ResNet-18 (CIFAR stem, 10 classes) is ~11.2M params.
+        model = ResNet18(input_shape=(32, 32, 3))
+        import jax
+
+        v = model.init(0)
+        n = sum(x.size for x in jax.tree_util.tree_leaves(v["params"]))
+        assert 10.5e6 < n < 11.5e6, n
+
+    def test_resnet50_builds_and_steps(self, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = ResNet50(num_classes=10, input_shape=(32, 32, 3))
+            model.compile(loss=SparseCategoricalCrossentropy(from_logits=True),
+                          optimizer=SGD(learning_rate=0.01),
+                          metrics=["accuracy"])
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, 16).astype(np.int64)
+        ds = td.Dataset.from_tensor_slices((x, y)).batch(16)
+        hist = model.fit(ds, epochs=1, steps_per_epoch=1, verbose=0)
+        assert np.isfinite(hist.history["loss"][0])
+
+    def test_resnet18_trains_on_separable_data(self, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = ResNet18(num_classes=4, input_shape=(16, 16, 1))
+            model.compile(loss=SparseCategoricalCrossentropy(from_logits=True),
+                          optimizer=SGD(learning_rate=0.05),
+                          metrics=["accuracy"])
+        rng = np.random.default_rng(0)
+        y = rng.integers(0, 4, 128).astype(np.int64)
+        x = np.zeros((128, 16, 16, 1), np.float32)
+        for k in range(4):  # one bright quadrant per class
+            r, c = divmod(k, 2)
+            x[y == k, r * 8:(r + 1) * 8, c * 8:(c + 1) * 8] = 1.0
+        x += rng.normal(0, 0.05, x.shape).astype(np.float32)
+        ds = td.Dataset.from_tensor_slices((x, y)).batch(64)
+        hist = model.fit(ds, epochs=4, steps_per_epoch=2, verbose=0)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+
+class TestMixedPrecision:
+    def test_policy_roundtrip(self):
+        import jax.numpy as jnp
+
+        assert td.models.policy() == "float32"
+        td.models.set_policy("mixed_bfloat16")
+        try:
+            assert td.models.compute_dtype() == jnp.bfloat16
+        finally:
+            td.models.set_policy("float32")
+
+    def test_bf16_forward_returns_f32_logits(self):
+        td.models.set_policy("mixed_bfloat16")
+        try:
+            model = td.models.build_cnn_model()
+            v = model.init(0)
+            x = np.zeros((2, 28, 28, 1), np.float32)
+            logits, _ = model.apply(v["params"], v["state"], x)
+            assert logits.dtype == np.float32
+            # Params stay float32 under the mixed policy.
+            import jax
+
+            assert all(p.dtype == np.float32
+                       for p in jax.tree_util.tree_leaves(v["params"]))
+        finally:
+            td.models.set_policy("float32")
+
+    def test_bf16_training_step_finite(self, eight_devices):
+        td.models.set_policy("mixed_bfloat16")
+        try:
+            s = td.MirroredStrategy()
+            with s.scope():
+                model = td.build_and_compile_cnn_model()
+            rng = np.random.default_rng(0)
+            x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+            y = rng.integers(0, 10, 16).astype(np.int64)
+            ds = td.Dataset.from_tensor_slices((x, y)).batch(16)
+            hist = model.fit(ds, epochs=1, steps_per_epoch=1, verbose=0)
+            assert np.isfinite(hist.history["loss"][0])
+        finally:
+            td.models.set_policy("float32")

@@ -4,6 +4,7 @@ from tpu_dist.models.layers import (
     Activation,
     AveragePooling2D,
     BatchNormalization,
+    Block,
     Conv2D,
     Dense,
     Dropout,
@@ -12,14 +13,18 @@ from tpu_dist.models.layers import (
     Layer,
     MaxPooling2D,
     ReLU,
+    Residual,
 )
 from tpu_dist.models.model import Model, Sequential
 from tpu_dist.models.cnn import build_and_compile_cnn_model, build_cnn_model
+from tpu_dist.models.policy import compute_dtype, policy, set_policy
+from tpu_dist.models.resnet import ResNet18, ResNet50
 
 __all__ = [
     "Activation",
     "AveragePooling2D",
     "BatchNormalization",
+    "Block",
     "Conv2D",
     "Dense",
     "Dropout",
@@ -28,8 +33,14 @@ __all__ = [
     "Layer",
     "MaxPooling2D",
     "ReLU",
+    "Residual",
     "Model",
     "Sequential",
+    "ResNet18",
+    "ResNet50",
     "build_and_compile_cnn_model",
     "build_cnn_model",
+    "compute_dtype",
+    "policy",
+    "set_policy",
 ]

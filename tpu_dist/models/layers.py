@@ -283,6 +283,130 @@ class BatchNormalization(Layer):
         return y.astype(x.dtype), new_state
 
 
+def unique_layer_names(layers: Sequence[Layer]) -> list[str]:
+    """kind, kind_1, kind_2, ... — stable param-tree keys for a layer list."""
+    import collections
+
+    counts: collections.Counter = collections.Counter()
+    names = []
+    for layer in layers:
+        k = layer.kind
+        names.append(k if counts[k] == 0 else f"{k}_{counts[k]}")
+        counts[k] += 1
+    return names
+
+
+def init_chain(layers: Sequence[Layer], names: Sequence[str], key, in_shape):
+    """Initialize a layer chain; returns (params, state, out_shape)."""
+    params: dict = {}
+    state: dict = {}
+    shape = tuple(in_shape)
+    keys = jax.random.split(key, max(len(layers), 1))
+    for layer, name, k in zip(layers, names, keys):
+        p, s, shape = layer.init(k, shape)
+        if p:
+            params[name] = p
+        if s:
+            state[name] = s
+    return params, state, shape
+
+
+def apply_chain(layers: Sequence[Layer], names: Sequence[str], params, state,
+                x, *, training: bool, rng):
+    """Apply a layer chain; returns (y, new_state). Dropout layers receive
+    per-layer keys folded from ``rng``."""
+    new_state = dict(state) if state else {}
+    for i, (layer, name) in enumerate(zip(layers, names)):
+        p = params.get(name, {}) if params else {}
+        s = state.get(name, {}) if state else {}
+        # Every layer gets a per-position key (containers thread it down to
+        # nested Dropouts); layers that don't use randomness ignore it.
+        layer_rng = jax.random.fold_in(rng, i) if rng is not None else None
+        x, s_new = layer.apply(p, s, x, training=training, rng=layer_rng)
+        if s_new:
+            new_state[name] = s_new
+    return x, new_state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Block(Layer):
+    """A named sub-stack of layers — composable container for deep models
+    (ResNet stages, BASELINE.md configs 4-5). Params/state nest under the
+    sublayer names."""
+
+    layers: tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "layers", tuple(self.layers))
+        object.__setattr__(self, "_names",
+                           tuple(unique_layer_names(self.layers)))
+
+    def init(self, key, in_shape):
+        return init_chain(self.layers, self._names, key, in_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return apply_chain(self.layers, self._names, params, state, x,
+                           training=training, rng=rng)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Residual(Layer):
+    """``activation(main(x) + shortcut(x))`` — the residual connection.
+
+    ``shortcut=()`` is the identity skip; a projection (1x1 conv + BN) goes
+    there when shapes change. The building block of the ResNet benchmark
+    models; XLA fuses the add into the preceding conv/BN epilogue on TPU.
+    """
+
+    main: tuple = ()
+    shortcut: tuple = ()
+    activation: Optional[str] = "relu"
+
+    def __post_init__(self):
+        object.__setattr__(self, "main", tuple(self.main))
+        object.__setattr__(self, "shortcut", tuple(self.shortcut))
+        object.__setattr__(self, "_main_names",
+                           tuple(unique_layer_names(self.main)))
+        object.__setattr__(self, "_short_names",
+                           tuple(unique_layer_names(self.shortcut)))
+
+    def init(self, key, in_shape):
+        k_main, k_short = jax.random.split(key)
+        p_main, s_main, out_main = init_chain(self.main, self._main_names,
+                                              k_main, in_shape)
+        p_short, s_short, out_short = init_chain(
+            self.shortcut, self._short_names, k_short, in_shape)
+        if out_main != out_short:
+            raise ValueError(
+                f"residual branches disagree: main -> {out_main}, "
+                f"shortcut -> {out_short}")
+        params = {"main": p_main}
+        state = {}
+        if p_short:
+            params["shortcut"] = p_short
+        if s_main:
+            state["main"] = s_main
+        if s_short:
+            state["shortcut"] = s_short
+        return params, state, out_main
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, s_main = apply_chain(
+            self.main, self._main_names, params.get("main", {}),
+            state.get("main", {}) if state else {}, x,
+            training=training, rng=rng)
+        sc, s_short = apply_chain(
+            self.shortcut, self._short_names, params.get("shortcut", {}),
+            state.get("shortcut", {}) if state else {}, x,
+            training=training, rng=rng)
+        new_state = {}
+        if s_main:
+            new_state["main"] = s_main
+        if s_short:
+            new_state["shortcut"] = s_short
+        return _activation(self.activation)(y + sc), new_state
+
+
 @dataclasses.dataclass(frozen=True, repr=False)
 class Dropout(Layer):
     rate: float = 0.5

@@ -19,7 +19,6 @@ creation; here it pins which mesh the variables will be replicated onto when
 
 from __future__ import annotations
 
-import collections
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -163,45 +162,32 @@ class Sequential(Model):
 
     @staticmethod
     def _unique_names(layers: Sequence[Layer]) -> list[str]:
-        counts: collections.Counter = collections.Counter()
-        names = []
-        for layer in layers:
-            k = layer.kind
-            names.append(k if counts[k] == 0 else f"{k}_{counts[k]}")
-            counts[k] += 1
-        return names
+        from tpu_dist.models.layers import unique_layer_names
+
+        return unique_layer_names(layers)
 
     def _init_layers(self, key, input_shape):
-        params: dict = {}
-        state: dict = {}
-        shape = tuple(input_shape)
-        keys = jax.random.split(key, len(self.layers))
-        for layer, name, k in zip(self.layers, self.layer_names, keys):
-            p, s, shape = layer.init(k, shape)
-            if p:
-                params[name] = p
-            if s:
-                state[name] = s
+        from tpu_dist.models.layers import init_chain
+
+        params, state, shape = init_chain(self.layers, self.layer_names, key,
+                                          tuple(input_shape))
         self.output_shape = shape
         return params, state
 
     def _apply_layers(self, params, state, x, training, rng):
-        new_state = dict(state) if state else {}
-        n_drop = sum(1 for l in self.layers if l.kind.startswith("dropout"))
-        drop_keys = (list(jax.random.split(rng, max(n_drop, 1)))
-                     if rng is not None else [])
-        di = 0
-        for layer, name in zip(self.layers, self.layer_names):
-            p = params.get(name, {})
-            s = state.get(name, {}) if state else {}
-            layer_rng = None
-            if layer.kind.startswith("dropout") and drop_keys:
-                layer_rng = drop_keys[di]
-                di += 1
-            x, s_new = layer.apply(p, s, x, training=training, rng=layer_rng)
-            if s_new:
-                new_state[name] = s_new
-        return x, new_state
+        from tpu_dist.models.layers import apply_chain
+        from tpu_dist.models.policy import compute_dtype
+
+        # Mixed-precision entry/exit casts (policy.py): activations run in the
+        # compute dtype, the returned logits in float32 for a stable loss.
+        dtype = compute_dtype()
+        if x.dtype != dtype and jax.numpy.issubdtype(x.dtype, jax.numpy.floating):
+            x = x.astype(dtype)
+        y, new_state = apply_chain(self.layers, self.layer_names, params,
+                                   state, x, training=training, rng=rng)
+        if jax.numpy.issubdtype(y.dtype, jax.numpy.floating):
+            y = y.astype(jax.numpy.float32)
+        return y, new_state
 
     def summary(self) -> str:
         lines = [f'Model: "{self.name}"', "-" * 46]

@@ -1,0 +1,47 @@
+"""Mixed-precision policy: bfloat16 compute, float32 params and state.
+
+TPU-native analog of Keras' ``mixed_precision.set_global_policy`` — on TPU the
+MXU natively multiplies bfloat16 operands, so casting activations to bfloat16
+roughly doubles matmul/conv throughput and halves activation HBM traffic while
+float32 parameters, BatchNorm statistics, and the loss keep full precision
+(the standard TPU recipe; no loss-scaling is needed because bfloat16 keeps
+float32's exponent range, unlike float16/CUDA).
+
+    tpu_dist.models.set_policy("mixed_bfloat16")   # or "float32"
+
+The model containers cast inputs to ``compute_dtype()`` on entry and cast
+outputs back to float32, and every layer casts its params to the activation
+dtype at use (see layers.py), so a policy flip requires no model changes.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+_POLICIES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "mixed_bfloat16": jnp.bfloat16,
+}
+
+_lock = threading.Lock()
+_current = "float32"
+
+
+def set_policy(name: str) -> None:
+    global _current
+    if name not in _POLICIES:
+        raise ValueError(
+            f"unknown policy {name!r}; available: {sorted(_POLICIES)}")
+    with _lock:
+        _current = name
+
+
+def policy() -> str:
+    return _current
+
+
+def compute_dtype():
+    return _POLICIES[_current]
