@@ -31,6 +31,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multiprocess: spawns loopback multi-worker processes (slower)")
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devices = jax.devices()

@@ -1,0 +1,83 @@
+"""Aux subsystems (SURVEY.md §5): profiler hooks, structured logging, liveness."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.cluster.liveness import LivenessMonitor, check_peer_health
+from tpu_dist.training.callbacks import JSONLogger
+from tpu_dist.utils import profiler
+
+
+def _compiled_model():
+    m = td.models.Sequential(
+        [td.models.Dense(8, activation="relu"), td.models.Dense(4)],
+        input_shape=(8,))
+    m.compile(loss="sparse_categorical_crossentropy", optimizer="sgd",
+              metrics=["accuracy"])
+    return m
+
+
+def _ds(n=64, batch=16):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    y = rng.integers(0, 4, n).astype(np.int64)
+    return td.Dataset.from_tensor_slices((x, y)).batch(batch)
+
+
+class TestProfiler:
+    def test_fit_writes_trace(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _compiled_model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0,
+                  profile_dir=str(tmp_path / "trace"))
+        # jax.profiler writes plugins/profile/<run>/*.xplane.pb
+        found = [p for p, _, files in os.walk(tmp_path)
+                 for f in files if f.endswith(".xplane.pb")]
+        assert found, list(os.walk(str(tmp_path)))
+
+    def test_step_annotation_free_when_inactive(self):
+        import contextlib
+
+        assert not profiler.is_active()
+        assert isinstance(profiler.step_annotation(0),
+                          contextlib.nullcontext)
+
+
+class TestJSONLogger:
+    def test_epoch_records_written(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _compiled_model()
+        path = tmp_path / "train.jsonl"
+        model.fit(_ds(), epochs=3, steps_per_epoch=2, verbose=0,
+                  callbacks=[JSONLogger(str(path))])
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        epochs = [r for r in lines if r["event"] == "epoch"]
+        assert len(epochs) == 3
+        assert all("loss" in r and "accuracy" in r for r in epochs)
+
+    def test_batch_records_opt_in(self, tmp_path, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _compiled_model()
+        path = tmp_path / "train.jsonl"
+        model.fit(_ds(), epochs=1, steps_per_epoch=4, verbose=0,
+                  callbacks=[JSONLogger(str(path), log_batches=True)])
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert sum(r["event"] == "batch" for r in lines) == 4
+
+
+class TestLivenessSingleProcess:
+    def test_no_dead_peers(self):
+        assert list(check_peer_health()) == []
+
+    def test_monitor_noop_single_process(self):
+        m = LivenessMonitor(interval_s=0.01).start()
+        assert m._thread is None  # single-process: nothing to monitor
+        m.raise_if_failed()  # must not raise
+        m.stop()

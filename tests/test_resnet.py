@@ -138,6 +138,23 @@ class TestMixedPrecision:
         finally:
             td.models.set_policy("float32")
 
+    def test_policy_change_invalidates_compiled_step(self, eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = td.models.build_and_compile_cnn_model()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 28, 28, 1)).astype(np.float32)
+        y = rng.integers(0, 10, 16).astype(np.int64)
+        ds = td.Dataset.from_tensor_slices((x, y)).batch(16)
+        model.fit(ds, epochs=1, steps_per_epoch=1, verbose=0)
+        step_f32 = model._trainer._train_step
+        td.models.set_policy("mixed_bfloat16")
+        try:
+            model.fit(ds, epochs=1, steps_per_epoch=1, verbose=0)
+            assert model._trainer._train_step is not step_f32
+        finally:
+            td.models.set_policy("float32")
+
     def test_bf16_training_step_finite(self, eight_devices):
         td.models.set_policy("mixed_bfloat16")
         try:

@@ -17,8 +17,16 @@ from tpu_dist.cluster.bootstrap import (
     process_count,
     process_index,
 )
+from tpu_dist.cluster.liveness import (
+    LivenessMonitor,
+    PeerUnavailableError,
+    check_peer_health,
+)
 
 __all__ = [
+    "LivenessMonitor",
+    "PeerUnavailableError",
+    "check_peer_health",
     "TF_CONFIG_ENV",
     "ClusterConfig",
     "ClusterConfigError",

@@ -63,12 +63,18 @@ def initialize(config: ClusterConfig | None = None, *,
         if config is None:
             config = ClusterConfig.from_env()
 
+        # Failure-detection latency knob (SURVEY.md D12: TF probes every 30 s
+        # with 10 s timeouts; JAX's coordination service heartbeats instead).
+        # Exposed mainly so fault tests can shrink detection time.
+        hb = float(os.environ.get("TPU_DIST_HEARTBEAT_TIMEOUT_S", "100"))
+
         if coordinator_address is not None:
             # Explicit JAX-style bring-up, bypassing TF_CONFIG.
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
+                heartbeat_timeout_seconds=max(1, round(hb)),
             )
             _log_bringup()
         elif config is not None and config.num_processes > 1:
@@ -85,11 +91,13 @@ def initialize(config: ClusterConfig | None = None, *,
                 coordinator_address=config.coordinator_address,
                 num_processes=config.num_processes,
                 process_id=config.process_id,
+                heartbeat_timeout_seconds=max(1, round(hb)),
             )
             _log_bringup()
         elif config is None and _tpu_pod_env_present():
             logger.info("tpu_dist: no TF_CONFIG; using TPU pod autodetection")
-            jax.distributed.initialize()
+            jax.distributed.initialize(
+                heartbeat_timeout_seconds=max(1, round(hb)))
             _log_bringup()
         else:
             # Single-process local mode (README.md:34): nothing to bring up.

@@ -1,0 +1,158 @@
+"""Peer liveness monitoring on top of the JAX coordination service.
+
+The reference's failure-detection story (SURVEY.md D12, §5.3) is a Python
+health-check thread: every worker pings every peer every 30 s
+(``check_collective_ops_peer_health``, 3 retries x 10 s timeout); an
+unreachable peer aborts collectives with ``UnavailableError`` and the job must
+be restarted — fail-fast, no elasticity
+(tf:...collective_all_reduce_strategy.py:337-349, 990-1042).
+
+TPU-native translation: the C++ coordination service started by
+``jax.distributed.initialize`` already heartbeats every process (the D11
+equivalent ships with jaxlib). This module surfaces it at the framework level:
+
+* :func:`check_peer_health` — one-shot liveness probe of every peer
+  (``get_live_nodes`` on the coordination-service client).
+* :class:`LivenessMonitor` — the D12 analog: background thread probing every
+  ``interval`` seconds; a dead peer marks the monitor failed, and
+  :meth:`raise_if_failed` (called by the fit loop between epochs) surfaces a
+  :class:`PeerUnavailableError` — restart-required semantics, matching, not
+  exceeding, the reference (no elastic recovery there either).
+
+The startup barrier that keeps health checks from firing during bring-up
+(tf:...collective_all_reduce_strategy.py:1043-1066) is
+``bootstrap.barrier()``, run by MultiWorkerMirroredStrategy before any
+monitor starts.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Optional, Sequence
+
+logger = logging.getLogger("tpu_dist.liveness")
+
+#: Reference knobs (tf:...collective_all_reduce_strategy.py:337-349):
+#: check every 30 s, 10 s per-probe timeout.
+DEFAULT_INTERVAL_S = float(os.environ.get("TPU_DIST_HEALTH_INTERVAL", "30"))
+DEFAULT_TIMEOUT_S = float(os.environ.get("TPU_DIST_HEALTH_TIMEOUT", "10"))
+
+
+class PeerUnavailableError(RuntimeError):
+    """A peer process is unreachable; the job must be restarted.
+
+    The analog of TF's ``UnavailableError`` from the health-check thread
+    (SURVEY.md §5.3: fail-fast-and-restart, paired with checkpoint/resume).
+    """
+
+
+def _client():
+    from jax._src import distributed
+
+    return distributed.global_state.client
+
+
+def check_peer_health(timeout_s: float = DEFAULT_TIMEOUT_S,
+                      retries: int = 3) -> Sequence[int]:
+    """Probe peer liveness; returns the list of dead process ids.
+
+    A transient coordination-service RPC failure is retried ``retries`` times
+    (the reference's 3-retry rule, tf:...collective_all_reduce_strategy.py:
+    337-349) with the ``timeout_s`` budget spread across the attempts; only
+    when every attempt fails does this raise :class:`PeerUnavailableError`
+    (the service itself is unreachable). A *successful* probe that reports a
+    dead peer needs no debouncing — the service only declares a node dead
+    after its own heartbeat timeout. Single-process jobs trivially report no
+    dead peers.
+    """
+    import time
+
+    import jax
+
+    n = jax.process_count()
+    if n <= 1:
+        return []
+    client = _client()
+    if client is None:
+        return []
+    last_error = None
+    for attempt in range(max(retries, 1)):
+        try:
+            live = client.get_live_nodes(list(range(n)))
+            return sorted(set(range(n)) - set(live))
+        except Exception as e:
+            last_error = e
+            logger.warning("liveness probe attempt %d/%d failed: %s",
+                           attempt + 1, retries, e)
+            time.sleep(timeout_s / max(retries, 1))
+    raise PeerUnavailableError(
+        f"coordination service unreachable after {retries} probe attempts: "
+        f"{last_error}. Restart the job.")
+
+
+class LivenessMonitor:
+    """Background peer-health thread — the D12 health-check analog."""
+
+    def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dead_peers: Sequence[int] = []
+        self._failed = threading.Event()
+
+    def start(self) -> "LivenessMonitor":
+        import jax
+
+        if jax.process_count() <= 1 or self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="tpu_dist_health", daemon=True)
+        self._thread.start()
+        logger.info("liveness monitor started (interval=%.0fs, timeout=%.0fs)",
+                    self.interval_s, self.timeout_s)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                dead = check_peer_health(self.timeout_s)
+            except PeerUnavailableError:
+                # Service unreachable even after retries: treat every peer as
+                # suspect; raise_if_failed will surface it.
+                import jax
+
+                dead = [i for i in range(jax.process_count())
+                        if i != jax.process_index()]
+            if dead:
+                self._dead_peers = dead
+                self._failed.set()
+                logger.error(
+                    "peer process(es) %s unreachable; collectives will not "
+                    "complete — restart the job (reference semantics: "
+                    "UnavailableError, SURVEY.md §5.3)", dead)
+                return
+
+    @property
+    def failed(self) -> bool:
+        return self._failed.is_set()
+
+    @property
+    def dead_peers(self) -> Sequence[int]:
+        return list(self._dead_peers)
+
+    def raise_if_failed(self) -> None:
+        if self.failed:
+            raise PeerUnavailableError(
+                f"peer process(es) {list(self._dead_peers)} are unreachable; "
+                "synchronous training cannot continue. Restart the job "
+                "(resume from the latest checkpoint if one was written).")

@@ -96,8 +96,11 @@ class Model:
 
     def fit(self, x, epochs: int = 1, steps_per_epoch: Optional[int] = None,
             verbose: int = 1, callbacks: Sequence = (), initial_epoch: int = 0,
-            seed: int = 0):
-        """Run the epoch/step loop (tf_dist_example.py:59 surface)."""
+            seed: int = 0, profile_dir: Optional[str] = None):
+        """Run the epoch/step loop (tf_dist_example.py:59 surface).
+
+        ``profile_dir`` captures a chief-only jax.profiler trace of the run
+        (SURVEY.md §5.1)."""
         from tpu_dist.training.trainer import Trainer
 
         if self.loss is None or self.optimizer is None:
@@ -109,7 +112,7 @@ class Model:
         return self._trainer.fit(
             x, epochs=epochs, steps_per_epoch=steps_per_epoch,
             verbose=verbose, callbacks=callbacks, initial_epoch=initial_epoch,
-            seed=seed)
+            seed=seed, profile_dir=profile_dir)
 
     def evaluate(self, x, steps: Optional[int] = None, verbose: int = 1):
         from tpu_dist.training.trainer import Trainer

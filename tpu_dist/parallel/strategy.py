@@ -190,6 +190,12 @@ class MultiWorkerMirroredStrategy(Strategy):
         bootstrap.initialize(config=cluster_config)
         super().__init__()  # all global devices
         bootstrap.barrier("MultiWorkerMirroredStrategy_init")
+        # Peer-health monitoring starts only after the startup barrier, so it
+        # can't fire during bring-up (tf:...collective_all_reduce_strategy.py:
+        # 1043-1066 ordering; SURVEY.md D12). No-op for single-process jobs.
+        from tpu_dist.cluster.liveness import LivenessMonitor
+
+        self.liveness_monitor = LivenessMonitor().start()
         # Bring-up log, the analog of TF's "MultiWorkerMirroredStrategy with
         # cluster_spec = {...}, num_workers = N" line (SURVEY.md §3.5).
         cfg = bootstrap.cluster_config()

@@ -5,6 +5,7 @@ from tpu_dist.training.callbacks import (
     Callback,
     EarlyStopping,
     History,
+    JSONLogger,
     LambdaCallback,
     ModelCheckpoint,
     StopTraining,
@@ -16,6 +17,7 @@ __all__ = [
     "Callback",
     "EarlyStopping",
     "History",
+    "JSONLogger",
     "LambdaCallback",
     "ModelCheckpoint",
     "StopTraining",

@@ -136,6 +136,53 @@ class EarlyStopping(Callback):
                 raise StopTraining(f"EarlyStopping at epoch {epoch}")
 
 
+class JSONLogger(Callback):
+    """Structured per-epoch training log: one JSON line per epoch, chief-only.
+
+    The §5.5 observability surface (SURVEY.md): loss, metrics, epoch time and
+    steps/sec in a machine-readable stream — the analog of the reference era's
+    CSVLogger + the INFO logging this framework's collectives module provides
+    for all-reduce shapes. Append mode supports resumed runs.
+    """
+
+    def __init__(self, path: str, *, log_batches: bool = False):
+        self.path = path
+        self.wants_batches = log_batches
+        self._file = None
+
+    def _chief(self) -> bool:
+        from tpu_dist.cluster import bootstrap
+
+        return bootstrap.is_chief()
+
+    def on_train_begin(self):
+        if self._chief():
+            import os
+
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                        exist_ok=True)
+            self._file = open(self.path, "a", buffering=1)
+
+    def on_train_end(self):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+
+    def _write(self, record: dict):
+        if self._file is not None:
+            import json
+
+            self._file.write(json.dumps(record) + "\n")
+
+    def on_epoch_end(self, epoch, logs):
+        self._write({"event": "epoch", "epoch": epoch,
+                     **{k: round(float(v), 6) for k, v in logs.items()}})
+
+    def on_batch_end(self, step, logs):
+        self._write({"event": "batch", "step": step,
+                     **{k: round(float(v), 6) for k, v in logs.items()}})
+
+
 class StopTraining(Exception):
     """Raised by callbacks to end fit cleanly."""
 

@@ -43,6 +43,7 @@ from tpu_dist.cluster import bootstrap
 from tpu_dist.data.distribute import DistributedDataset
 from tpu_dist.data.pipeline import Dataset
 from tpu_dist.training.callbacks import CallbackList, History, StopTraining
+from tpu_dist.utils import profiler
 from tpu_dist.utils.progbar import ProgressBar
 
 logger = logging.getLogger("tpu_dist.trainer")
@@ -62,6 +63,22 @@ class Trainer:
         self._predict_fn = None
         self._iterator = None
         self._iterator_source = None
+        self._built_policy: Optional[str] = None
+
+    def _maybe_invalidate_for_policy(self) -> None:
+        """Drop cached compiled steps when the global mixed-precision policy
+        changed after they were traced — compute_dtype() is read at trace
+        time, so a stale cache would silently keep the old dtype."""
+        from tpu_dist.models.policy import policy
+
+        current = policy()
+        if self._built_policy is not None and self._built_policy != current:
+            logger.info("precision policy changed %s -> %s; recompiling steps",
+                        self._built_policy, current)
+            self._train_step = None
+            self._eval_step = None
+            self._predict_fn = None
+        self._built_policy = current
 
     # -- variable materialization (D4: mirrored init, chief broadcast) -------
 
@@ -194,8 +211,9 @@ class Trainer:
 
     def fit(self, x, *, epochs: int, steps_per_epoch: Optional[int],
             verbose: int, callbacks: Sequence, initial_epoch: int,
-            seed: int) -> History:
+            seed: int, profile_dir: Optional[str] = None) -> History:
         self.ensure_variables(seed)
+        self._maybe_invalidate_for_policy()
         if self._train_step is None:
             self._train_step = self._build_train_step()
         dist = self._distribute(x)
@@ -213,17 +231,32 @@ class Trainer:
         root_key = jax.random.PRNGKey(seed ^ 0x5EED)
 
         cbs.on_train_begin()
+        # Chief-only TensorBoard-compatible trace around the whole fit span
+        # (SURVEY.md §5.1; README.md:51 chief duty).
+        import contextlib
+
+        ctx = (profiler.trace(profile_dir) if profile_dir
+               else contextlib.nullcontext())
         try:
-            self._run_epochs(dist, cbs, initial_epoch, epochs, steps_per_epoch,
-                             show, root_key)
+            with ctx:
+                self._run_epochs(dist, cbs, initial_epoch, epochs,
+                                 steps_per_epoch, show, root_key)
         except StopTraining as e:
             logger.info("training stopped early: %s", e)
-        cbs.on_train_end()
+        finally:
+            # Runs even on the failure path (e.g. PeerUnavailableError) so
+            # callbacks finalize — a JSONLogger's file matters most there.
+            cbs.on_train_end()
         return history
 
     def _run_epochs(self, dist, cbs, initial_epoch, epochs, steps_per_epoch,
                     show, root_key):
+        monitor = getattr(self.strategy, "liveness_monitor", None)
         for epoch in range(initial_epoch, epochs):
+            if monitor is not None:
+                # Surface a dead peer as a restartable error instead of letting
+                # the next collective hang (SURVEY.md §5.3 failure semantics).
+                monitor.raise_if_failed()
             cbs.on_epoch_begin(epoch)
             if show:
                 print(f"Epoch {epoch + 1}/{epochs}")
@@ -241,10 +274,11 @@ class Trainer:
             for step_i in range(steps_per_epoch):
                 xb, yb = self._next_batch(dist)
                 rng = jax.random.fold_in(root_key, epoch * 100003 + step_i)
-                (loss, v["params"], v["state"], v["opt"], v["metrics"],
-                 loss_acc) = self._train_step(v["params"], v["state"], v["opt"],
-                                              v["metrics"], loss_acc, xb, yb,
-                                              rng)
+                with profiler.step_annotation(epoch * steps_per_epoch + step_i):
+                    (loss, v["params"], v["state"], v["opt"], v["metrics"],
+                     loss_acc) = self._train_step(
+                        v["params"], v["state"], v["opt"], v["metrics"],
+                        loss_acc, xb, yb, rng)
                 if eager_loss:
                     loss_val = float(loss)
                     loss_running += loss_val
@@ -259,6 +293,7 @@ class Trainer:
 
     def evaluate(self, x, *, steps: Optional[int], verbose: int) -> dict:
         self.ensure_variables()
+        self._maybe_invalidate_for_policy()
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         dist = self._distribute(x)
@@ -285,6 +320,7 @@ class Trainer:
 
     def predict(self, x):
         self.ensure_variables()
+        self._maybe_invalidate_for_policy()
         model = self.model
         if self._predict_fn is None:
             self._predict_fn = jax.jit(

@@ -1,5 +1,6 @@
 """Utilities: progress bar, profiling, structured logging."""
 
+from tpu_dist.utils import profiler
 from tpu_dist.utils.progbar import ProgressBar
 
-__all__ = ["ProgressBar"]
+__all__ = ["ProgressBar", "profiler"]

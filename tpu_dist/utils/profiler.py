@@ -1,0 +1,75 @@
+"""Profiling hooks: TensorBoard-compatible traces, chief-only by default.
+
+The reference's observability surface is the chief's TensorBoard duty
+(README.md:51; SURVEY.md §5.1) — profiling was the era's Keras progbar timing
+plus an uninvoked TF profiler. TPU-native: ``jax.profiler`` writes XLA/TPU
+traces (HLO timelines, ICI collective activity) viewable in TensorBoard or
+Perfetto; :func:`trace` wraps a fit/eval span, :func:`step_annotation` marks
+step boundaries so the trace viewer aligns host dispatch with device work.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Iterator, Optional
+
+logger = logging.getLogger("tpu_dist.profiler")
+
+#: True while a trace span is open in this process — lets hot loops skip
+#: annotation overhead entirely when nothing is recording.
+_ACTIVE = False
+
+
+def is_active() -> bool:
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def trace(logdir: str | os.PathLike, *, chief_only: bool = True) -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed span.
+
+    ``chief_only`` matches the reference's "chief generates TensorBoard"
+    division of labor (README.md:51): non-chief processes run the body
+    untraced.
+    """
+    import jax
+
+    from tpu_dist.cluster import bootstrap
+
+    if chief_only and not bootstrap.is_chief():
+        yield
+        return
+    global _ACTIVE
+    logdir = str(logdir)
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    _ACTIVE = True
+    logger.info("profiler trace started -> %s", logdir)
+    try:
+        yield
+    finally:
+        _ACTIVE = False
+        jax.profiler.stop_trace()
+        logger.info("profiler trace written -> %s", logdir)
+
+
+def step_annotation(step: int):
+    """Context manager annotating one train step in the trace timeline.
+
+    Free when no trace is active (returns a null context)."""
+    if not _ACTIVE:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.StepTraceAnnotation("train", step_num=step)
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named trace span (host-side), e.g. around input pipeline sections."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
