@@ -27,12 +27,19 @@ monitor starts.
 
 from __future__ import annotations
 
+import concurrent.futures
 import logging
 import os
 import threading
 from typing import Optional, Sequence
 
 logger = logging.getLogger("tpu_dist.liveness")
+
+#: Single worker thread for bounded probes; a timed-out probe keeps the slot
+#: busy until the RPC actually returns, which is fine — the next attempt just
+#: queues behind it rather than piling threads up.
+_PROBE_POOL = concurrent.futures.ThreadPoolExecutor(
+    max_workers=1, thread_name_prefix="tpu_dist_probe")
 
 #: Reference knobs (tf:...collective_all_reduce_strategy.py:337-349):
 #: check every 30 s, 10 s per-probe timeout.
@@ -78,15 +85,23 @@ def check_peer_health(timeout_s: float = DEFAULT_TIMEOUT_S,
     if client is None:
         return []
     last_error = None
-    for attempt in range(max(retries, 1)):
+    retries = max(retries, 1)
+    per_attempt = timeout_s / retries
+    for attempt in range(retries):
+        if attempt:
+            time.sleep(per_attempt)
         try:
-            live = client.get_live_nodes(list(range(n)))
+            # get_live_nodes has no RPC deadline of its own; bound it so a
+            # partitioned (reachable-but-unresponsive) coordinator can't hang
+            # the probe — the 10 s-per-attempt rule the reference uses.
+            future = _PROBE_POOL.submit(
+                client.get_live_nodes, list(range(n)))
+            live = future.result(timeout=per_attempt)
             return sorted(set(range(n)) - set(live))
         except Exception as e:
             last_error = e
             logger.warning("liveness probe attempt %d/%d failed: %s",
                            attempt + 1, retries, e)
-            time.sleep(timeout_s / max(retries, 1))
     raise PeerUnavailableError(
         f"coordination service unreachable after {retries} probe attempts: "
         f"{last_error}. Restart the job.")
@@ -120,7 +135,13 @@ class LivenessMonitor:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=self.timeout_s)
-            self._thread = None
+            if self._thread.is_alive():
+                # Still blocked in a probe: leave the handle so a later
+                # start() can't spawn a second concurrent loop.
+                logger.warning("liveness monitor thread did not stop within "
+                               "%.0fs; leaving it to finish", self.timeout_s)
+            else:
+                self._thread = None
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -156,3 +177,17 @@ class LivenessMonitor:
                 f"peer process(es) {list(self._dead_peers)} are unreachable; "
                 "synchronous training cannot continue. Restart the job "
                 "(resume from the latest checkpoint if one was written).")
+
+
+_shared_monitor: Optional[LivenessMonitor] = None
+_shared_lock = threading.Lock()
+
+
+def shared_monitor() -> LivenessMonitor:
+    """Per-process singleton monitor — repeated strategy constructions reuse
+    one probe thread instead of leaking one per instance."""
+    global _shared_monitor
+    with _shared_lock:
+        if _shared_monitor is None:
+            _shared_monitor = LivenessMonitor()
+        return _shared_monitor

@@ -192,10 +192,11 @@ class MultiWorkerMirroredStrategy(Strategy):
         bootstrap.barrier("MultiWorkerMirroredStrategy_init")
         # Peer-health monitoring starts only after the startup barrier, so it
         # can't fire during bring-up (tf:...collective_all_reduce_strategy.py:
-        # 1043-1066 ordering; SURVEY.md D12). No-op for single-process jobs.
-        from tpu_dist.cluster.liveness import LivenessMonitor
+        # 1043-1066 ordering; SURVEY.md D12). No-op for single-process jobs;
+        # a per-process singleton so repeated constructions don't leak threads.
+        from tpu_dist.cluster.liveness import shared_monitor
 
-        self.liveness_monitor = LivenessMonitor().start()
+        self.liveness_monitor = shared_monitor().start()
         # Bring-up log, the analog of TF's "MultiWorkerMirroredStrategy with
         # cluster_spec = {...}, num_workers = N" line (SURVEY.md §3.5).
         cfg = bootstrap.cluster_config()

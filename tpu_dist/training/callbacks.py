@@ -147,7 +147,8 @@ class JSONLogger(Callback):
 
     def __init__(self, path: str, *, log_batches: bool = False):
         self.path = path
-        self.wants_batches = log_batches
+        self._log_batches = log_batches
+        self.wants_batches = False  # resolved per-process at train begin
         self._file = None
 
     def _chief(self) -> bool:
@@ -156,7 +157,11 @@ class JSONLogger(Callback):
         return bootstrap.is_chief()
 
     def on_train_begin(self):
-        if self._chief():
+        chief = self._chief()
+        # Only the chief writes, so only the chief should make the trainer pay
+        # the per-step device->host loss sync batch logging requires.
+        self.wants_batches = self._log_batches and chief
+        if chief:
             import os
 
             os.makedirs(os.path.dirname(os.path.abspath(self.path)),
