@@ -6,9 +6,11 @@ MultiWorkerMirroredStrategy. This is the same program on the TPU-native stack:
 same TF_CONFIG shape, same strategy/scope/compile/fit surface, same dataset
 pipeline and shard-policy semantics, same model and hyperparameters.
 
-Run one process per worker with per-worker TF_CONFIG (README.md:156-162
-launch recipe), or run it with no TF_CONFIG for single-host training
-(README.md:34 degradation rule):
+Run one process per worker with per-worker TF_CONFIG (launch recipe at
+reference README.md:156-162), or run it with no TF_CONFIG for single-host
+training (the one-worker degradation rule, reference README.md:34). All
+README.md:N citations in this file point at the reference repo's README,
+matching the convention used throughout tpu_dist docstrings:
 
     # worker 0 (also the chief)
     TF_CONFIG='{"cluster":{"worker":["10.0.0.1:12345","10.0.0.2:12345"]},

@@ -27,19 +27,12 @@ monitor starts.
 
 from __future__ import annotations
 
-import concurrent.futures
 import logging
 import os
 import threading
 from typing import Optional, Sequence
 
 logger = logging.getLogger("tpu_dist.liveness")
-
-#: Single worker thread for bounded probes; a timed-out probe keeps the slot
-#: busy until the RPC actually returns, which is fine — the next attempt just
-#: queues behind it rather than piling threads up.
-_PROBE_POOL = concurrent.futures.ThreadPoolExecutor(
-    max_workers=1, thread_name_prefix="tpu_dist_probe")
 
 #: Reference knobs (tf:...collective_all_reduce_strategy.py:337-349):
 #: check every 30 s, 10 s per-probe timeout.
@@ -84,24 +77,35 @@ def check_peer_health(timeout_s: float = DEFAULT_TIMEOUT_S,
     client = _client()
     if client is None:
         return []
-    last_error = None
+    last_error: object = None
     retries = max(retries, 1)
-    per_attempt = timeout_s / retries
     for attempt in range(retries):
-        if attempt:
-            time.sleep(per_attempt)
-        try:
-            # get_live_nodes has no RPC deadline of its own; bound it so a
-            # partitioned (reachable-but-unresponsive) coordinator can't hang
-            # the probe — the 10 s-per-attempt rule the reference uses.
-            future = _PROBE_POOL.submit(
-                client.get_live_nodes, list(range(n)))
-            live = future.result(timeout=per_attempt)
-            return sorted(set(range(n)) - set(live))
-        except Exception as e:
-            last_error = e
-            logger.warning("liveness probe attempt %d/%d failed: %s",
-                           attempt + 1, retries, e)
+        # Each attempt gets the FULL timeout_s deadline (the reference's
+        # 3 x 10 s rule) on its own daemon thread: get_live_nodes has no RPC
+        # deadline of its own, so a partitioned (reachable-but-unresponsive)
+        # coordinator would otherwise hang the probe; a daemon thread also
+        # can't block interpreter exit, and attempts never queue behind a
+        # still-hung predecessor.
+        result: list = []
+
+        def _probe(out=result):
+            try:
+                out.append(client.get_live_nodes(list(range(n))))
+            except Exception as e:  # stash; re-raised as probe failure below
+                out.append(e)
+
+        t = threading.Thread(target=_probe, daemon=True,
+                             name="tpu_dist_probe")
+        t.start()
+        t.join(timeout=timeout_s)
+        if result and not isinstance(result[0], Exception):
+            return sorted(set(range(n)) - set(result[0]))
+        last_error = result[0] if result else TimeoutError(
+            f"probe did not answer within {timeout_s}s")
+        logger.warning("liveness probe attempt %d/%d failed: %s",
+                       attempt + 1, retries, last_error)
+        if attempt + 1 < retries:
+            time.sleep(min(1.0, timeout_s / 10))
     raise PeerUnavailableError(
         f"coordination service unreachable after {retries} probe attempts: "
         f"{last_error}. Restart the job.")
@@ -122,8 +126,15 @@ class LivenessMonitor:
     def start(self) -> "LivenessMonitor":
         import jax
 
-        if jax.process_count() <= 1 or self._thread is not None:
+        if jax.process_count() <= 1:
             return self
+        if self._thread is not None and self._thread.is_alive():
+            return self  # already running
+        if self.failed:
+            return self  # peer failure is terminal — restart the job
+        # Re-arm after a stop() or a naturally-exited loop, so the shared
+        # singleton handed to a fresh strategy actually probes again.
+        self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="tpu_dist_health", daemon=True)
         self._thread.start()
@@ -137,11 +148,12 @@ class LivenessMonitor:
             self._thread.join(timeout=self.timeout_s)
             if self._thread.is_alive():
                 # Still blocked in a probe: leave the handle so a later
-                # start() can't spawn a second concurrent loop.
+                # start() sees it alive and won't spawn a second loop.
                 logger.warning("liveness monitor thread did not stop within "
                                "%.0fs; leaving it to finish", self.timeout_s)
             else:
                 self._thread = None
+                # start() clears _stop when re-arming.
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):

@@ -55,14 +55,31 @@ class Loss:
 
 class SparseCategoricalCrossentropy(Loss):
     """tf.keras.losses.SparseCategoricalCrossentropy analog
-    (tf_dist_example.py:50)."""
+    (tf_dist_example.py:50).
 
-    def __init__(self, from_logits: bool = False):
-        super().__init__(
-            lambda logits, labels: sparse_categorical_crossentropy(
-                logits, labels, from_logits=from_logits),
-            "sparse_categorical_crossentropy")
+    ``fused=True`` routes through the Pallas TPU kernel
+    (tpu_dist.ops.pallas_kernels.fused_sparse_cross_entropy): one VMEM pass
+    for max/logsumexp/gather forward and softmax-minus-onehot backward.
+    Opt-in: a pallas_call is a single-device program, so under a
+    multi-device-sharded jit the XLA-partitioned jnp form (the default) is
+    the right choice; the fused path targets per-device use (e.g. inside
+    shard_map or single-chip benchmarking). Requires ``from_logits=True``.
+    """
+
+    def __init__(self, from_logits: bool = False, fused: bool = False):
+        if fused and not from_logits:
+            raise ValueError("fused CE operates on logits; "
+                             "pass from_logits=True")
+        if fused:
+            from tpu_dist.ops.pallas_kernels import fused_sparse_cross_entropy
+
+            fn = fused_sparse_cross_entropy
+        else:
+            fn = lambda logits, labels: sparse_categorical_crossentropy(
+                logits, labels, from_logits=from_logits)
+        super().__init__(fn, "sparse_categorical_crossentropy")
         self.from_logits = from_logits
+        self.fused = fused
 
 
 class CategoricalCrossentropy(Loss):

@@ -1,0 +1,179 @@
+"""Pallas TPU kernels — the hand-written escape hatch (SURVEY.md §2.4).
+
+The reference's native compute path is TF's C++/CUDA kernels; on TPU the
+idiomatic equivalent is XLA-compiled programs, and SURVEY.md §2.4 reserves
+Pallas for ops worth fusing beyond what XLA does: "a Pallas kernel for a fused
+scale-and-cross-entropy or custom reduction is the escape hatch". Implemented
+here:
+
+* :func:`fused_sparse_cross_entropy` — softmax-cross-entropy from logits with
+  integer labels, forward and backward each as ONE VMEM-resident kernel:
+  max / logsumexp / label-gather fused (forward), softmax-minus-onehot fused
+  (backward), with a `jax.custom_vjp` tying them together. Replaces 4-5
+  separate HLO reductions/gathers with one pass over the logits block.
+
+Kernels run on TPU; every entry point takes ``interpret=`` (Pallas interpreter,
+used by the CPU test suite) and the public wrapper falls back to the plain
+jnp implementation on non-TPU backends, so the framework is correct
+everywhere and fast where it matters.
+
+Grid strategy: 1-D over batch tiles; each program owns a ``(TILE_B, C)``
+logits block in VMEM (classes padded to the 128-lane by Mosaic). Labels ride
+along as a ``(TILE_B, 1)`` int32 block; the one-hot is built with
+``broadcasted_iota`` (TPU needs >= 2-D iota).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+TILE_B = 128  # batch rows per program; fp32 sublane min is 8, MXU-friendly
+
+
+def _pick_tile(batch: int) -> int:
+    if batch % TILE_B == 0:
+        return TILE_B
+    for t in (64, 32, 16, 8):
+        if batch % t == 0:
+            return t
+    return batch  # tiny/ragged batch: single tile
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _ce_fwd_kernel(logits_ref, labels_ref, loss_ref, lse_ref):
+    """loss_i = logsumexp(logits_i) - logits_i[label_i]; stashes the lse."""
+    logits = logits_ref[:].astype(jnp.float32)          # (TB, C)
+    labels = labels_ref[:]                               # (TB, 1) int32
+    m = jnp.max(logits, axis=-1, keepdims=True)          # (TB, 1)
+    shifted = logits - m
+    lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1, keepdims=True)) + m
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, dimension=1)
+    picked = jnp.sum(jnp.where(cols == labels, logits, 0.0), axis=-1,
+                     keepdims=True)                      # (TB, 1)
+    loss_ref[:] = (lse - picked)
+    lse_ref[:] = lse
+
+
+def _ce_fwd(logits, labels, *, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, c = logits.shape
+    tb = _pick_tile(b)
+    labels2 = labels.astype(jnp.int32).reshape(b, 1)
+    loss, lse = pl.pallas_call(
+        _ce_fwd_kernel,
+        grid=(b // tb,),
+        in_specs=[
+            pl.BlockSpec((tb, c), lambda i: (i, 0),
+                         memory_space=pl.ANY if interpret else pltpu.VMEM),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0),
+                         memory_space=pl.ANY if interpret else pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tb, 1), lambda i: (i, 0),
+                         memory_space=pl.ANY if interpret else pltpu.VMEM),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0),
+                         memory_space=pl.ANY if interpret else pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(logits, labels2)
+    return loss[:, 0], lse
+
+
+# -- backward -----------------------------------------------------------------
+
+
+def _ce_bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dlogits_ref):
+    """dlogits = (softmax(logits) - onehot(labels)) * g."""
+    logits = logits_ref[:].astype(jnp.float32)
+    labels = labels_ref[:]
+    lse = lse_ref[:]
+    g = g_ref[:]
+    probs = jnp.exp(logits - lse)                        # softmax via saved lse
+    cols = jax.lax.broadcasted_iota(jnp.int32, logits.shape, dimension=1)
+    onehot = (cols == labels).astype(jnp.float32)
+    dlogits_ref[:] = ((probs - onehot) * g).astype(dlogits_ref.dtype)
+
+
+def _ce_bwd(logits, labels, lse, g, *, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, c = logits.shape
+    tb = _pick_tile(b)
+    labels2 = labels.astype(jnp.int32).reshape(b, 1)
+    g2 = g.astype(jnp.float32).reshape(b, 1)
+    space = pl.ANY if interpret else pltpu.VMEM
+    return pl.pallas_call(
+        _ce_bwd_kernel,
+        grid=(b // tb,),
+        in_specs=[
+            pl.BlockSpec((tb, c), lambda i: (i, 0), memory_space=space),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0), memory_space=space),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0), memory_space=space),
+            pl.BlockSpec((tb, 1), lambda i: (i, 0), memory_space=space),
+        ],
+        out_specs=pl.BlockSpec((tb, c), lambda i: (i, 0), memory_space=space),
+        out_shape=jax.ShapeDtypeStruct((b, c), logits.dtype),
+        interpret=interpret,
+    )(logits, labels2, lse, g2)
+
+
+# -- public op with custom VJP ------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fused_ce(logits, labels, interpret):
+    loss, _ = _ce_fwd(logits, labels, interpret=interpret)
+    return loss
+
+
+def _fused_ce_fwd(logits, labels, interpret):
+    loss, lse = _ce_fwd(logits, labels, interpret=interpret)
+    return loss, (logits, labels, lse)
+
+
+def _fused_ce_bwd(interpret, residuals, g):
+    logits, labels, lse = residuals
+    dlogits = _ce_bwd(logits, labels, lse, g, interpret=interpret)
+    return dlogits, None
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def fused_sparse_cross_entropy(logits, labels, *,
+                               interpret: bool | None = None):
+    """Per-example softmax CE from logits, Pallas-fused on TPU.
+
+    [B, C] logits x [B] int labels -> [B] losses, differentiable w.r.t.
+    ``logits``. On non-TPU backends (and for ragged shapes Pallas can't tile)
+    this is the plain jnp computation — bit-comparable results either way.
+    ``interpret=True`` forces the Pallas interpreter (CPU-testable path).
+    """
+    if interpret is None:
+        interpret = False
+        if not _on_tpu():
+            # jnp fallback: identical math, XLA-fused well enough off-TPU.
+            from tpu_dist.ops.losses import sparse_categorical_crossentropy
+
+            return sparse_categorical_crossentropy(logits, labels,
+                                                   from_logits=True)
+    return _fused_ce(logits, labels, interpret)
