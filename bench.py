@@ -78,7 +78,8 @@ def load_batch(dataset_name: str, shape, global_batch: int):
     return x, y
 
 
-def run(config: str, steps: int, warmup: int, global_batch: int | None) -> dict:
+def run(config: str, steps: int, warmup: int, global_batch: int | None,
+        spe: int = 1) -> dict:
     import jax
 
     from tpu_dist.parallel.strategy import MirroredStrategy
@@ -94,41 +95,57 @@ def run(config: str, steps: int, warmup: int, global_batch: int | None) -> dict:
     with strategy.scope():
         model = build_model(kind, shape)
 
-    from tpu_dist.training.trainer import Trainer
+    from tpu_dist.training.trainer import Trainer, jnp_stack_keys
 
     trainer = Trainer(model)
     trainer.ensure_variables(seed=0)
-    train_step = trainer._build_train_step()
 
     # Device-resident batches, pre-sharded: the benchmark measures the compiled
     # step (fwd+loss+bwd+allreduce+update), with input delivery off the timed
     # path — matching how the reference's steady-state step time was read
     # (cached tf.data pipeline, SURVEY.md §3.4).
-    x, y = load_batch(dataset_name, shape, global_batch)
-    xb = strategy.distribute_batch(x)
-    yb = strategy.distribute_batch(y)
-
-    v = trainer.variables
     key = jax.random.PRNGKey(0)
-    # Per-step keys precomputed off the timed path — fold_in is an eager
-    # device op whose dispatch would otherwise pollute the dispatch-bound
-    # step-time measurement.
-    keys = [jax.random.fold_in(key, i) for i in range(warmup + steps)]
+    v = trainer.variables
     state = (v["params"], v["state"], v["opt"], v["metrics"],
              trainer._init_loss_acc())
 
-    def one_step(state, i):
-        loss, p, s, o, m, acc = train_step(*state, xb, yb, keys[i])
+    if spe > 1:
+        # steps_per_execution: one dispatch runs `spe` scanned steps over
+        # distinct stacked batches (trainer._build_multi_step).
+        # Round the step counts up to whole executions.
+        steps = -(-steps // spe) * spe
+        warmup = -(-warmup // spe) * spe
+        train_fn = trainer._build_multi_step()
+        x, y = load_batch(dataset_name, shape, global_batch * spe)
+        xb = strategy.distribute_batch_stack(
+            x.reshape(spe, global_batch, *shape))
+        yb = strategy.distribute_batch_stack(y.reshape(spe, global_batch))
+        keys = [jnp_stack_keys(key, i * spe, spe)
+                for i in range((warmup + steps) // spe)]
+        n_exec_warm, n_exec = warmup // spe, steps // spe
+    else:
+        train_fn = trainer._build_train_step()
+        x, y = load_batch(dataset_name, shape, global_batch)
+        xb = strategy.distribute_batch(x)
+        yb = strategy.distribute_batch(y)
+        # Per-step keys precomputed off the timed path — fold_in is an eager
+        # device op whose dispatch would otherwise pollute the dispatch-bound
+        # step-time measurement.
+        keys = [jax.random.fold_in(key, i) for i in range(warmup + steps)]
+        n_exec_warm, n_exec = warmup, steps
+
+    def one_exec(state, i):
+        loss, p, s, o, m, acc = train_fn(*state, xb, yb, keys[i])
         return loss, (p, s, o, m, acc)
 
     loss = None
-    for i in range(warmup):
-        loss, state = one_step(state, i)
+    for i in range(n_exec_warm):
+        loss, state = one_exec(state, i)
     jax.block_until_ready((loss, state))
 
     t0 = time.perf_counter()
-    for i in range(warmup, warmup + steps):
-        loss, state = one_step(state, i)
+    for i in range(n_exec_warm, n_exec_warm + n_exec):
+        loss, state = one_exec(state, i)
     jax.block_until_ready((loss, state))
     elapsed = time.perf_counter() - t0
 
@@ -141,6 +158,7 @@ def run(config: str, steps: int, warmup: int, global_batch: int | None) -> dict:
         "platform": jax.devices()[0].platform,
         "global_batch": global_batch,
         "steps": steps,
+        "steps_per_execution": spe,
         "step_ms": round(step_ms, 4),
         "images_per_sec": round(img_per_sec, 1),
         "images_per_sec_per_core": round(img_per_sec_per_core, 1),
@@ -155,9 +173,12 @@ def main(argv=None) -> int:
     parser.add_argument("--steps", type=int, default=200)
     parser.add_argument("--warmup", type=int, default=20)
     parser.add_argument("--batch", type=int, default=None)
+    parser.add_argument("--spe", type=int, default=16,
+                        help="steps per execution (lax.scan inside one "
+                             "dispatch); 1 = classic per-step dispatch")
     args = parser.parse_args(argv)
 
-    result = run(args.config, args.steps, args.warmup, args.batch)
+    result = run(args.config, args.steps, args.warmup, args.batch, args.spe)
     print(json.dumps(result), file=sys.stderr)
 
     if args.config == "mnist_cnn":

@@ -71,7 +71,10 @@ class DistributedDataset:
     def auto_shard_policy(self) -> AutoShardPolicy:
         return self._policy
 
-    def __iter__(self) -> Iterator:
+    def iter_local(self) -> Iterator:
+        """Validated HOST batches (numpy) — the pre-placement stream. Used by
+        the multi-step (steps_per_execution) path, which stacks K host
+        batches before one device placement."""
         devices_per_process = len(self._strategy.mesh.local_devices)
 
         for batch in self._local:
@@ -87,6 +90,10 @@ class DistributedDataset:
                     "local device(s); adjust the batch size so every replica "
                     "gets an equal shard (same constraint as TF per-replica "
                     "splitting)")
+            yield batch
+
+    def __iter__(self) -> Iterator:
+        for batch in self.iter_local():
             yield self._strategy.distribute_batch(batch)
 
 

@@ -61,7 +61,10 @@ def _load() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        path = _SO_PATH if _SO_PATH.exists() else _build()
+        src = _SRC_DIR / "loader.cpp"
+        stale = (_SO_PATH.exists() and src.exists()
+                 and src.stat().st_mtime > _SO_PATH.stat().st_mtime)
+        path = _SO_PATH if _SO_PATH.exists() and not stale else _build()
         if path is None:
             _build_failed = True
             return None
@@ -170,17 +173,16 @@ def native_pipeline(name: str, *, global_batch_size: int, seed: int = 0,
     if global_batch_size > n:
         raise ValueError(f"batch {global_batch_size} exceeds dataset size {n}")
     epoch_counter = [0]
+    steps = (n // global_batch_size if drop_remainder
+             else -(-n // global_batch_size))
 
     def factory():
         # Fresh permutation each pass — Dataset re-invokes the factory per
         # epoch, reproducing shuffle-per-epoch semantics deterministically.
         perm = shuffled_indices(n, seed + 0x9E37 * epoch_counter[0])
         epoch_counter[0] += 1
-        steps = n // global_batch_size if drop_remainder else -(-n // global_batch_size)
         for s in range(steps):
             idx = perm[s * global_batch_size:(s + 1) * global_batch_size]
             yield (gather_scale(images, idx, scale), gather_labels(labels, idx))
 
-    ds = Dataset(factory, cardinality=n // global_batch_size if drop_remainder
-                 else -(-n // global_batch_size))
-    return ds
+    return Dataset(factory, cardinality=steps)

@@ -48,6 +48,7 @@ class Model:
         self.optimizer = None
         self.loss = None
         self.metrics: list = []
+        self.steps_per_execution = 1
         self.strategy = None
         self._trainer = None
         self._carryover: Optional[dict] = None  # weights across recompiles
@@ -76,14 +77,26 @@ class Model:
 
     # -- Keras-style training surface (SURVEY.md D15/D16) ---------------------
 
-    def compile(self, optimizer="sgd", loss=None, metrics=()) -> None:
+    def compile(self, optimizer="sgd", loss=None, metrics=(),
+                steps_per_execution: int = 1) -> None:
         """Record loss/optimizer/metrics and capture the scoped strategy
-        (tf_dist_example.py:50-53 surface)."""
+        (tf_dist_example.py:50-53 surface).
+
+        ``steps_per_execution``: run K train steps inside one compiled
+        dispatch (``lax.scan``) — the Keras knob of the same name; a large
+        win when per-step device time is smaller than host dispatch overhead
+        (tiny-model training; SURVEY.md hard-part #5). Batch-level callbacks
+        and the progress bar then advance once per execution.
+        """
         from tpu_dist.parallel.strategy import get_strategy
 
+        if steps_per_execution < 1:
+            raise ValueError(
+                f"steps_per_execution must be >= 1, got {steps_per_execution}")
         self.optimizer = optimizers_lib.get(optimizer)
         self.loss = losses_lib.get(loss) if loss is not None else None
         self.metrics = [metrics_lib.get(m) for m in metrics]
+        self.steps_per_execution = int(steps_per_execution)
         self.strategy = get_strategy()
         # Invalidate the jitted step but carry trained weights forward —
         # recompiling must not reset a trained model (Keras fine-tuning

@@ -170,8 +170,9 @@ def fused_sparse_cross_entropy(logits, labels, *,
     """
     if interpret is None:
         interpret = False
-        if not _on_tpu():
-            # jnp fallback: identical math, XLA-fused well enough off-TPU.
+        # Fall back to jnp math off-TPU, and on-TPU for ragged batches whose
+        # only tile is sublane-unaligned (Mosaic wants multiples of 8 rows).
+        if not _on_tpu() or _pick_tile(logits.shape[0]) % 8 != 0:
             from tpu_dist.ops.losses import sparse_categorical_crossentropy
 
             return sparse_categorical_crossentropy(logits, labels,

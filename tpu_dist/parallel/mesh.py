@@ -124,6 +124,26 @@ def shard_batch(batch, mesh, axis: str = DATA_AXIS):
     return jax.tree_util.tree_map(_place, batch)
 
 
+def shard_batch_stack(batch, mesh, axis: str = DATA_AXIS):
+    """Place a pytree of K-stacked host batches onto the mesh: leading axis is
+    the execution/step axis (replicated), the SECOND axis is the batch dim,
+    split across ``axis`` — the layout consumed by the multi-step
+    (steps_per_execution) train function."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has axes {mesh.axis_names}, no {axis!r}")
+    sharding = NamedSharding(mesh, PartitionSpec(None, axis))
+
+    def _place(x):
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(_place, batch)
+
+
 def replicate(tree, mesh, *, broadcast: bool = False):
     """Place a pytree replicated on every mesh device.
 

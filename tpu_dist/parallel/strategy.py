@@ -109,6 +109,11 @@ class Strategy:
         """Host batch pytree -> global device array, batch-dim sharded."""
         return mesh_lib.shard_batch(batch, self._mesh, self.data_axis)
 
+    def distribute_batch_stack(self, stack):
+        """K-stacked host batches -> device array (K replicated, batch dim
+        sharded) for multi-step executions (steps_per_execution)."""
+        return mesh_lib.shard_batch_stack(stack, self._mesh, self.data_axis)
+
     def experimental_distribute_dataset(self, dataset, policy=None):
         """Wrap a ``tpu_dist.data.Dataset`` for per-replica delivery — the
         analog of the commented alternative at tf_dist_example.py:36. The

@@ -49,6 +49,14 @@ from tpu_dist.utils.progbar import ProgressBar
 logger = logging.getLogger("tpu_dist.trainer")
 
 
+def jnp_stack_keys(root_key, base: int, k: int):
+    """[k, keydim] stacked fold_in keys for a scanned multi-step execution."""
+    import jax.numpy as jnp
+
+    return jax.vmap(lambda i: jax.random.fold_in(root_key, i))(
+        base + jnp.arange(k))
+
+
 class Trainer:
     """Owns device-resident training variables and the compiled steps."""
 
@@ -63,6 +71,8 @@ class Trainer:
         self._predict_fn = None
         self._iterator = None
         self._iterator_source = None
+        self._iterator_kind = "device"
+        self._multi_step = None
         self._built_policy: Optional[str] = None
 
     def _maybe_invalidate_for_policy(self) -> None:
@@ -76,6 +86,7 @@ class Trainer:
             logger.info("precision policy changed %s -> %s; recompiling steps",
                         self._built_policy, current)
             self._train_step = None
+            self._multi_step = None
             self._eval_step = None
             self._predict_fn = None
         self._built_policy = current
@@ -125,11 +136,12 @@ class Trainer:
 
     # -- compiled steps -------------------------------------------------------
 
-    def _build_train_step(self):
+    def _pure_step(self):
+        """The un-jitted SPMD train step: (vars..., x, y, rng) -> (loss,
+        vars...). Shared by the single-step jit and the scanned multi-step."""
         model, loss_obj, optimizer = (self.model, self.model.loss,
                                       self.model.optimizer)
         metrics = tuple(model.metrics)
-        rep = self.strategy.param_sharding()
 
         def step(params, state, opt_state, metric_states, loss_acc, x, y, rng):
             def loss_fn(p):
@@ -149,16 +161,56 @@ class Trainer:
             new_acc = (loss_acc[0] + loss, loss_acc[1] + 1.0)
             return loss, new_params, new_state, new_opt, new_metrics, new_acc
 
+        return step
+
+    def _out_shardings(self):
+        rep = self.strategy.param_sharding()
+
         def rep_like(tree):
             return jax.tree_util.tree_map(lambda _: rep, tree)
 
         v = self.variables
         acc = self._init_loss_acc()
+        return (None, rep_like(v["params"]), rep_like(v["state"]),
+                rep_like(v["opt"]), rep_like(v["metrics"]), rep_like(acc))
+
+    def _build_train_step(self):
         return jax.jit(
-            step,
-            out_shardings=(None, rep_like(v["params"]), rep_like(v["state"]),
-                           rep_like(v["opt"]), rep_like(v["metrics"]),
-                           rep_like(acc)),
+            self._pure_step(),
+            out_shardings=self._out_shardings(),
+            donate_argnums=(0, 1, 2, 3, 4),
+        )
+
+    def _build_multi_step(self):
+        """``lax.scan`` over K train steps inside ONE compiled dispatch —
+        the Keras ``steps_per_execution`` knob, and the cure for
+        dispatch-bound tiny steps (SURVEY.md hard-part #5): host dispatch
+        cost is paid once per K steps instead of per step.
+
+        Batches and rng keys for the K steps arrive stacked on a leading
+        axis (K is a trace-time constant from the stack shape); the scan
+        carries (params, state, opt, metrics, loss_acc) and the mean of the
+        K losses is returned as the execution's loss.
+        """
+        step = self._pure_step()
+
+        def one(carry, xs):
+            x, y, rng = xs
+            loss, *new_carry = step(*carry, x, y, rng)
+            return tuple(new_carry), loss
+
+        def multi(params, state, opt_state, metric_states, loss_acc,
+                  xs_stack, ys_stack, rngs):
+            carry, losses = jax.lax.scan(
+                one, (params, state, opt_state, metric_states, loss_acc),
+                (xs_stack, ys_stack, rngs))
+            params, state, opt_state, metric_states, loss_acc = carry
+            return (losses.mean(), params, state, opt_state, metric_states,
+                    loss_acc)
+
+        return jax.jit(
+            multi,
+            out_shardings=self._out_shardings(),
             donate_argnums=(0, 1, 2, 3, 4),
         )
 
@@ -192,16 +244,20 @@ class Trainer:
             f"fit/evaluate expects a Dataset, DistributedDataset or (x, y) "
             f"arrays; got {type(x).__name__}")
 
-    def _next_batch(self, dist: DistributedDataset):
+    def _next_batch(self, dist: DistributedDataset, *, host: bool = False):
         """Persistent-iterator semantics across epochs (Keras 2): re-create on
-        exhaustion — a fresh pass implies a fresh (re)shuffle."""
-        if self._iterator is None or self._iterator_source is not dist:
-            self._iterator = iter(dist)
+        exhaustion — a fresh pass implies a fresh (re)shuffle. ``host=True``
+        yields the pre-placement numpy batch (multi-step stacking path)."""
+        kind = "host" if host else "device"
+        if (self._iterator is None or self._iterator_source is not dist
+                or self._iterator_kind != kind):
+            self._iterator = dist.iter_local() if host else iter(dist)
             self._iterator_source = dist
+            self._iterator_kind = kind
         try:
             return next(self._iterator)
         except StopIteration:
-            self._iterator = iter(dist)
+            self._iterator = dist.iter_local() if host else iter(dist)
             batch = next(self._iterator, None)
             if batch is None:
                 raise RuntimeError("dataset yielded no batches")
@@ -216,6 +272,9 @@ class Trainer:
         self._maybe_invalidate_for_policy()
         if self._train_step is None:
             self._train_step = self._build_train_step()
+        if (getattr(self.model, "steps_per_execution", 1) > 1
+                and self._multi_step is None):
+            self._multi_step = self._build_multi_step()
         dist = self._distribute(x)
         if steps_per_epoch is None:
             steps_per_epoch = dist._local.cardinality()
@@ -271,19 +330,51 @@ class Trainer:
             eager_loss = bool(show) or cbs.has_batch_hooks
             loss_running = 0.0
             t_epoch = time.perf_counter()
-            for step_i in range(steps_per_epoch):
-                xb, yb = self._next_batch(dist)
-                rng = jax.random.fold_in(root_key, epoch * 100003 + step_i)
+            k = max(1, int(getattr(self.model, "steps_per_execution", 1)))
+            step_i = 0
+            executions = 0
+            while step_i < steps_per_epoch:
+                kk = min(k, steps_per_epoch - step_i)
                 with profiler.step_annotation(epoch * steps_per_epoch + step_i):
-                    (loss, v["params"], v["state"], v["opt"], v["metrics"],
-                     loss_acc) = self._train_step(
-                        v["params"], v["state"], v["opt"], v["metrics"],
-                        loss_acc, xb, yb, rng)
+                    if kk == 1:
+                        if k > 1:
+                            # Tail step of a multi-step run: stay on the HOST
+                            # iterator — switching kinds would recreate the
+                            # iterator mid-epoch and replay batches.
+                            hb = self._next_batch(dist, host=True)
+                            xb, yb = self.strategy.distribute_batch(hb)
+                        else:
+                            xb, yb = self._next_batch(dist)
+                        rng = jax.random.fold_in(
+                            root_key, epoch * 100003 + step_i)
+                        (loss, v["params"], v["state"], v["opt"], v["metrics"],
+                         loss_acc) = self._train_step(
+                            v["params"], v["state"], v["opt"], v["metrics"],
+                            loss_acc, xb, yb, rng)
+                    else:
+                        # steps_per_execution: stack kk host batches, ONE
+                        # dispatch runs the scanned step (SURVEY.md
+                        # hard-part #5). loss comes back as the kk-mean.
+                        batches = [self._next_batch(dist, host=True)
+                                   for _ in range(kk)]
+                        xs = np.stack([b[0] for b in batches])
+                        ys = np.stack([b[1] for b in batches])
+                        xb, yb = self.strategy.distribute_batch_stack((xs, ys))
+                        rngs = jnp_stack_keys(root_key, epoch * 100003 + step_i,
+                                              kk)
+                        (loss, v["params"], v["state"], v["opt"], v["metrics"],
+                         loss_acc) = self._multi_step(
+                            v["params"], v["state"], v["opt"], v["metrics"],
+                            loss_acc, xb, yb, rngs)
+                step_i += kk
+                executions += 1
                 if eager_loss:
                     loss_val = float(loss)
                     loss_running += loss_val
-                    bar.update(step_i + 1, loss=loss_running / (step_i + 1))
-                    cbs.on_batch_end(step_i, {"loss": loss_val})
+                    bar.update(step_i, loss=loss_running / executions)
+                    # Keras steps_per_execution semantics: batch hooks fire
+                    # once per execution, logs carry the execution's loss.
+                    cbs.on_batch_end(step_i - 1, {"loss": loss_val})
             logs = {"loss": float(loss_acc[0]) / max(float(loss_acc[1]), 1.0),
                     "epoch_time": time.perf_counter() - t_epoch}
             for metric, mstate in zip(self.model.metrics, v["metrics"]):
