@@ -47,7 +47,10 @@ from tpu_dist.training import (
     Callback,
     EarlyStopping,
     History,
+    JSONLogger,
+    LambdaCallback,
     ModelCheckpoint,
+    TensorBoard,
     checkpoint,
 )
 
@@ -61,6 +64,7 @@ __all__ = [
     "CollectiveCommunication", "MirroredStrategy",
     "MultiWorkerMirroredStrategy", "ParameterServerStrategy", "ReduceOp",
     "Strategy", "get_strategy",
-    "Callback", "EarlyStopping", "History", "ModelCheckpoint", "checkpoint",
+    "Callback", "EarlyStopping", "History", "JSONLogger", "LambdaCallback",
+    "ModelCheckpoint", "TensorBoard", "checkpoint",
     "__version__",
 ]

@@ -64,7 +64,16 @@ def _load() -> Optional[ctypes.CDLL]:
         src = _SRC_DIR / "loader.cpp"
         stale = (_SO_PATH.exists() and src.exists()
                  and src.stat().st_mtime > _SO_PATH.stat().st_mtime)
-        path = _SO_PATH if _SO_PATH.exists() and not stale else _build()
+        if _SO_PATH.exists() and not stale:
+            path = _SO_PATH
+        else:
+            path = _build()
+            if path is None and _SO_PATH.exists():
+                # Rebuild failed (e.g. no toolchain) but a prebuilt — possibly
+                # stale — library exists: keep using it rather than losing the
+                # native path entirely.
+                logger.warning("using existing (possibly stale) %s", _SO_PATH)
+                path = _SO_PATH
         if path is None:
             _build_failed = True
             return None

@@ -109,11 +109,17 @@ class Model:
 
     def fit(self, x, epochs: int = 1, steps_per_epoch: Optional[int] = None,
             verbose: int = 1, callbacks: Sequence = (), initial_epoch: int = 0,
-            seed: int = 0, profile_dir: Optional[str] = None):
+            seed: int = 0, profile_dir: Optional[str] = None,
+            validation_data=None, validation_steps: Optional[int] = None,
+            checkpoint_dir: Optional[str] = None):
         """Run the epoch/step loop (tf_dist_example.py:59 surface).
 
-        ``profile_dir`` captures a chief-only jax.profiler trace of the run
-        (SURVEY.md §5.1)."""
+        ``profile_dir`` captures a chief-only jax.profiler trace (SURVEY.md
+        §5.1). ``validation_data`` runs a validation pass each epoch,
+        reported as ``val_``-prefixed logs. ``checkpoint_dir`` enables
+        chief-only per-epoch checkpointing AND resume-from-latest (SURVEY.md
+        §5.4): if the directory already holds checkpoints, training continues
+        from the epoch after the newest one."""
         from tpu_dist.training.trainer import Trainer
 
         if self.loss is None or self.optimizer is None:
@@ -125,7 +131,10 @@ class Model:
         return self._trainer.fit(
             x, epochs=epochs, steps_per_epoch=steps_per_epoch,
             verbose=verbose, callbacks=callbacks, initial_epoch=initial_epoch,
-            seed=seed, profile_dir=profile_dir)
+            seed=seed, profile_dir=profile_dir,
+            validation_data=validation_data,
+            validation_steps=validation_steps,
+            checkpoint_dir=checkpoint_dir)
 
     def evaluate(self, x, steps: Optional[int] = None, verbose: int = 1):
         from tpu_dist.training.trainer import Trainer

@@ -104,17 +104,14 @@ def batch_sharded(mesh, axis: str = DATA_AXIS):
     return NamedSharding(mesh, PartitionSpec(axis))
 
 
-def shard_batch(batch, mesh, axis: str = DATA_AXIS):
-    """Place a pytree of host arrays onto the mesh, batch-dim sharded.
-
-    Single-process path: ``jax.device_put`` splits the leading axis across
-    devices. Multi-process path: each process holds its own shard of the global
-    batch; ``make_array_from_process_local_data`` assembles the global array
-    view (SURVEY.md D14's TPU-native equivalent).
-    """
+def _shard_with_spec(batch, mesh, spec):
+    """Place a pytree of host arrays with the given PartitionSpec: one
+    ``device_put`` single-process, ``make_array_from_process_local_data``
+    assembly multi-process (SURVEY.md D14's TPU-native equivalent)."""
     import jax
+    from jax.sharding import NamedSharding
 
-    sharding = batch_sharded(mesh, axis)
+    sharding = NamedSharding(mesh, spec)
 
     def _place(x):
         if jax.process_count() > 1:
@@ -122,6 +119,15 @@ def shard_batch(batch, mesh, axis: str = DATA_AXIS):
         return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(_place, batch)
+
+
+def shard_batch(batch, mesh, axis: str = DATA_AXIS):
+    """Place a pytree of host arrays onto the mesh, batch-dim sharded."""
+    from jax.sharding import PartitionSpec
+
+    if axis not in mesh.axis_names:
+        raise ValueError(f"mesh has axes {mesh.axis_names}, no {axis!r}")
+    return _shard_with_spec(batch, mesh, PartitionSpec(axis))
 
 
 def shard_batch_stack(batch, mesh, axis: str = DATA_AXIS):
@@ -129,19 +135,11 @@ def shard_batch_stack(batch, mesh, axis: str = DATA_AXIS):
     the execution/step axis (replicated), the SECOND axis is the batch dim,
     split across ``axis`` — the layout consumed by the multi-step
     (steps_per_execution) train function."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec
+    from jax.sharding import PartitionSpec
 
     if axis not in mesh.axis_names:
         raise ValueError(f"mesh has axes {mesh.axis_names}, no {axis!r}")
-    sharding = NamedSharding(mesh, PartitionSpec(None, axis))
-
-    def _place(x):
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sharding, np.asarray(x))
-        return jax.device_put(x, sharding)
-
-    return jax.tree_util.tree_map(_place, batch)
+    return _shard_with_spec(batch, mesh, PartitionSpec(None, axis))
 
 
 def replicate(tree, mesh, *, broadcast: bool = False):

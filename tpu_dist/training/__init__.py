@@ -9,6 +9,7 @@ from tpu_dist.training.callbacks import (
     LambdaCallback,
     ModelCheckpoint,
     StopTraining,
+    TensorBoard,
 )
 from tpu_dist.training.trainer import Trainer
 
@@ -21,5 +22,6 @@ __all__ = [
     "LambdaCallback",
     "ModelCheckpoint",
     "StopTraining",
+    "TensorBoard",
     "Trainer",
 ]

@@ -188,6 +188,47 @@ class JSONLogger(Callback):
                      **{k: round(float(v), 6) for k, v in logs.items()}})
 
 
+class TensorBoard(Callback):
+    """Chief-only TensorBoard scalar logging — the README.md:51 chief duty
+    ('generates TensorBoard'). Writes per-epoch scalars (loss, metrics,
+    val_*) as TF event files via ``tf.summary`` when TensorFlow is importable;
+    otherwise logs a warning once and no-ops (TF is an optional dependency of
+    this framework, used only here)."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+        self._writer = None
+
+    def on_train_begin(self):
+        from tpu_dist.cluster import bootstrap
+
+        if not bootstrap.is_chief():
+            return
+        try:
+            import tensorflow as tf  # optional, event-file writer only
+
+            self._writer = tf.summary.create_file_writer(self.log_dir)
+        except ImportError:
+            logger.warning(
+                "TensorBoard callback: tensorflow is not importable; scalar "
+                "event files will not be written (use JSONLogger instead)")
+
+    def on_epoch_end(self, epoch, logs):
+        if self._writer is None:
+            return
+        import tensorflow as tf
+
+        with self._writer.as_default(step=epoch):
+            for k, v in logs.items():
+                tf.summary.scalar(f"epoch_{k}", float(v))
+        self._writer.flush()
+
+    def on_train_end(self):
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
 class StopTraining(Exception):
     """Raised by callbacks to end fit cleanly."""
 

@@ -181,13 +181,19 @@ def restore(directory: str | os.PathLike, template: Any, *,
 
 
 def restore_model(directory: str | os.PathLike, model, *,
-                  step: Optional[int] = None) -> int:
-    """Restore a compiled model's training variables in place (resume)."""
+                  step: Optional[int] = None, trainer=None) -> int:
+    """Restore a compiled model's training variables in place (resume).
+
+    ``trainer`` pins which Trainer's variables receive the restored state —
+    required when a Trainer other than ``model._trainer`` is driving (e.g.
+    the running trainer inside ``fit(checkpoint_dir=...)``); defaults to the
+    model's own trainer."""
     from tpu_dist.training.trainer import Trainer
 
-    if model._trainer is None:
-        model._trainer = Trainer(model)
-    trainer = model._trainer
+    if trainer is None:
+        if model._trainer is None:
+            model._trainer = Trainer(model)
+        trainer = model._trainer
     trainer.ensure_variables()
     v = trainer.variables
     template = {k: v[k] for k in ("params", "state", "opt") if k in v}

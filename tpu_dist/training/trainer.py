@@ -267,7 +267,9 @@ class Trainer:
 
     def fit(self, x, *, epochs: int, steps_per_epoch: Optional[int],
             verbose: int, callbacks: Sequence, initial_epoch: int,
-            seed: int, profile_dir: Optional[str] = None) -> History:
+            seed: int, profile_dir: Optional[str] = None,
+            validation_data=None, validation_steps: Optional[int] = None,
+            checkpoint_dir: Optional[str] = None) -> History:
         self.ensure_variables(seed)
         self._maybe_invalidate_for_policy()
         if self._train_step is None:
@@ -282,6 +284,34 @@ class Trainer:
                 raise ValueError(
                     "steps_per_epoch is required for datasets of unknown "
                     "cardinality (e.g. repeated/generator datasets)")
+
+        callbacks = list(callbacks)
+        if checkpoint_dir is not None:
+            # SURVEY.md §5.4: fit(checkpoint_dir=) = chief-writes-per-epoch +
+            # resume-from-latest. A restored step N means epoch N finished.
+            from tpu_dist.training import checkpoint as ckpt_lib
+            from tpu_dist.training.callbacks import ModelCheckpoint
+
+            try:
+                restored = ckpt_lib.restore_model(checkpoint_dir, self.model,
+                                                  trainer=self)
+                initial_epoch = max(initial_epoch, restored + 1)
+                logger.info("resumed from checkpoint step %d; starting at "
+                            "epoch %d", restored, initial_epoch)
+            except FileNotFoundError:
+                pass
+            callbacks.append(ModelCheckpoint(checkpoint_dir))
+
+        val_dist = val_steps = None
+        if validation_data is not None:
+            val_dist = self._distribute(validation_data)
+            val_steps = validation_steps
+            if val_steps is None:
+                val_steps = val_dist._local.cardinality()
+                if val_steps is None:
+                    raise ValueError(
+                        "validation_steps is required for validation datasets "
+                        "of unknown cardinality")
 
         history = History()
         cbs = CallbackList([history, *callbacks], model=self.model)
@@ -299,7 +329,8 @@ class Trainer:
         try:
             with ctx:
                 self._run_epochs(dist, cbs, initial_epoch, epochs,
-                                 steps_per_epoch, show, root_key)
+                                 steps_per_epoch, show, root_key,
+                                 val_dist=val_dist, val_steps=val_steps)
         except StopTraining as e:
             logger.info("training stopped early: %s", e)
         finally:
@@ -309,7 +340,7 @@ class Trainer:
         return history
 
     def _run_epochs(self, dist, cbs, initial_epoch, epochs, steps_per_epoch,
-                    show, root_key):
+                    show, root_key, val_dist=None, val_steps=None):
         monitor = getattr(self.strategy, "liveness_monitor", None)
         for epoch in range(initial_epoch, epochs):
             if monitor is not None:
@@ -357,15 +388,29 @@ class Trainer:
                         # hard-part #5). loss comes back as the kk-mean.
                         batches = [self._next_batch(dist, host=True)
                                    for _ in range(kk)]
-                        xs = np.stack([b[0] for b in batches])
-                        ys = np.stack([b[1] for b in batches])
-                        xb, yb = self.strategy.distribute_batch_stack((xs, ys))
-                        rngs = jnp_stack_keys(root_key, epoch * 100003 + step_i,
-                                              kk)
-                        (loss, v["params"], v["state"], v["opt"], v["metrics"],
-                         loss_acc) = self._multi_step(
-                            v["params"], v["state"], v["opt"], v["metrics"],
-                            loss_acc, xb, yb, rngs)
+                        if len({b[0].shape for b in batches}) == 1:
+                            xs = np.stack([b[0] for b in batches])
+                            ys = np.stack([b[1] for b in batches])
+                            xb, yb = self.strategy.distribute_batch_stack(
+                                (xs, ys))
+                            rngs = jnp_stack_keys(
+                                root_key, epoch * 100003 + step_i, kk)
+                            (loss, v["params"], v["state"], v["opt"],
+                             v["metrics"], loss_acc) = self._multi_step(
+                                v["params"], v["state"], v["opt"],
+                                v["metrics"], loss_acc, xb, yb, rngs)
+                        else:
+                            # Ragged batch in the window (drop_remainder=False
+                            # tail): un-stackable — run the collected batches
+                            # per-step instead of crashing.
+                            for j, hb in enumerate(batches):
+                                xb, yb = self.strategy.distribute_batch(hb)
+                                rng = jax.random.fold_in(
+                                    root_key, epoch * 100003 + step_i + j)
+                                (loss, v["params"], v["state"], v["opt"],
+                                 v["metrics"], loss_acc) = self._train_step(
+                                    v["params"], v["state"], v["opt"],
+                                    v["metrics"], loss_acc, xb, yb, rng)
                 step_i += kk
                 executions += 1
                 if eager_loss:
@@ -379,15 +424,29 @@ class Trainer:
                     "epoch_time": time.perf_counter() - t_epoch}
             for metric, mstate in zip(self.model.metrics, v["metrics"]):
                 logs[metric.name] = float(metric.result(mstate))
+            if val_dist is not None:
+                # Keras validation semantics: full validation pass at each
+                # epoch end, reported as val_-prefixed logs (feeds
+                # EarlyStopping/ModelCheckpoint monitors).
+                val_logs = self._evaluate_on(val_dist, steps=val_steps)
+                logs.update({f"val_{k}": v_ for k, v_ in val_logs.items()})
             bar.finish(logs)
             cbs.on_epoch_end(epoch, logs)
 
     def evaluate(self, x, *, steps: Optional[int], verbose: int) -> dict:
         self.ensure_variables()
         self._maybe_invalidate_for_policy()
+        logs = self._evaluate_on(self._distribute(x), steps=steps)
+        if verbose and bootstrap.is_chief():
+            print(" - ".join(f"{k}: {v_:.4f}" for k, v_ in logs.items()))
+        return logs
+
+    def _evaluate_on(self, dist: DistributedDataset,
+                     steps: Optional[int]) -> dict:
+        """One evaluation pass over ``dist``; shared by evaluate() and the
+        per-epoch validation hook of fit()."""
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
-        dist = self._distribute(x)
         v = self.variables
         metric_states = self._init_metric_states()
         loss_acc = self.strategy.replicate(
@@ -405,8 +464,6 @@ class Trainer:
         logs = {"loss": float(loss_acc[0]) / max(float(loss_acc[1]), 1.0)}
         for metric, mstate in zip(self.model.metrics, metric_states):
             logs[metric.name] = float(metric.result(mstate))
-        if verbose and bootstrap.is_chief():
-            print(" - ".join(f"{k}: {v_:.4f}" for k, v_ in logs.items()))
         return logs
 
     def predict(self, x):
