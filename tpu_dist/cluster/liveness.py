@@ -48,6 +48,77 @@ class PeerUnavailableError(RuntimeError):
     """
 
 
+class _Prober:
+    """One long-lived daemon thread that runs liveness probes with a deadline.
+
+    ``get_live_nodes`` has no RPC deadline of its own, so a partitioned
+    (reachable-but-unresponsive) coordinator can hang a probe indefinitely.
+    Running every probe on a single persistent worker bounds the damage to ONE
+    blocked thread per process, however long the coordinator stays wedged —
+    new attempts simply queue behind the hung call and time out in turn,
+    instead of each abandoning a fresh thread.
+    """
+
+    def __init__(self):
+        import queue
+
+        self._submit_lock = threading.Lock()
+        self._requests: "queue.Queue" = queue.Queue()
+        self._cv = threading.Condition()
+        self._results: dict = {}
+        self._abandoned: set = set()
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+
+    def _run(self) -> None:
+        while True:
+            seq, fn = self._requests.get()
+            with self._cv:
+                if seq in self._abandoned:
+                    # Caller timed out while this request was still queued
+                    # (e.g. behind a hung probe): skip the stale RPC entirely
+                    # so a backlog never delays the first fresh probe.
+                    self._abandoned.discard(seq)
+                    continue
+            try:
+                out = fn()
+            except Exception as e:  # returned to the caller as the result
+                out = e
+            with self._cv:
+                if seq in self._abandoned:
+                    self._abandoned.discard(seq)  # caller gave up mid-call
+                else:
+                    self._results[seq] = out
+                    self._cv.notify_all()
+
+    def probe(self, fn, timeout_s: float):
+        """Run ``fn()`` on the worker; returns its result/exception, or a
+        TimeoutError if no answer arrives within ``timeout_s``."""
+        import time
+
+        with self._submit_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="tpu_dist_probe")
+                self._thread.start()
+            self._seq += 1
+            seq = self._seq
+        self._requests.put((seq, fn))
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while seq not in self._results:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._abandoned.add(seq)
+                    return TimeoutError(
+                        f"probe did not answer within {timeout_s}s")
+                self._cv.wait(remaining)
+            return self._results.pop(seq)
+
+
+_prober = _Prober()
+
+
 def _client():
     from jax._src import distributed
 
@@ -81,27 +152,14 @@ def check_peer_health(timeout_s: float = DEFAULT_TIMEOUT_S,
     retries = max(retries, 1)
     for attempt in range(retries):
         # Each attempt gets the FULL timeout_s deadline (the reference's
-        # 3 x 10 s rule) on its own daemon thread: get_live_nodes has no RPC
-        # deadline of its own, so a partitioned (reachable-but-unresponsive)
-        # coordinator would otherwise hang the probe; a daemon thread also
-        # can't block interpreter exit, and attempts never queue behind a
-        # still-hung predecessor.
-        result: list = []
-
-        def _probe(out=result):
-            try:
-                out.append(client.get_live_nodes(list(range(n))))
-            except Exception as e:  # stash; re-raised as probe failure below
-                out.append(e)
-
-        t = threading.Thread(target=_probe, daemon=True,
-                             name="tpu_dist_probe")
-        t.start()
-        t.join(timeout=timeout_s)
-        if result and not isinstance(result[0], Exception):
-            return sorted(set(range(n)) - set(result[0]))
-        last_error = result[0] if result else TimeoutError(
-            f"probe did not answer within {timeout_s}s")
+        # 3 x 10 s rule), executed on the process-wide persistent probe
+        # thread (_Prober) so a wedged coordinator pins at most one blocked
+        # thread no matter how many attempts time out.
+        out = _prober.probe(lambda: client.get_live_nodes(list(range(n))),
+                            timeout_s)
+        if not isinstance(out, Exception):
+            return sorted(set(range(n)) - set(out))
+        last_error = out
         logger.warning("liveness probe attempt %d/%d failed: %s",
                        attempt + 1, retries, last_error)
         if attempt + 1 < retries:

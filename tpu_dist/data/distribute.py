@@ -45,7 +45,8 @@ class DistributedDataset:
     """
 
     def __init__(self, dataset: Dataset, strategy,
-                 policy: AutoShardPolicy | None = None):
+                 policy: AutoShardPolicy | None = None,
+                 prefetch: int | None = 2):
         import jax
 
         self._strategy = strategy
@@ -62,6 +63,12 @@ class DistributedDataset:
             self._local = shard_dataset(
                 dataset, self._num_processes, self._process_index,
                 self._policy, pre_batched=True)
+        # Host input off the step critical path by default (SURVEY.md §3.4 /
+        # hard-part #5): background-prefetch the local stream unless the user
+        # already did, mirroring TF's distribute-path auto-prefetch.
+        # ``prefetch=None`` opts out.
+        if prefetch and not getattr(self._local, "_prefetched", False):
+            self._local = self._local.prefetch(prefetch)
         if self._num_processes > 1:
             logger.info(
                 "DistributedDataset: policy=%s process=%d/%d",

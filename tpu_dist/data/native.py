@@ -41,11 +41,16 @@ _build_failed = False
 
 
 def _build() -> Optional[pathlib.Path]:
+    # Compile to a per-process temp file, then os.replace() it into place:
+    # several workers on one host may race the first build, and replace() is
+    # atomic so no process can ever CDLL a half-written .so.
     src = _SRC_DIR / "loader.cpp"
+    tmp = _SO_PATH.with_suffix(f".so.tmp.{os.getpid()}")
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", str(src),
-           "-o", str(_SO_PATH)]
+           "-o", str(tmp)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO_PATH)
         logger.info("built native loader: %s", _SO_PATH)
         return _SO_PATH
     except (OSError, subprocess.SubprocessError) as e:
@@ -53,6 +58,8 @@ def _build() -> Optional[pathlib.Path]:
         logger.warning("native loader build failed (%s %s); using numpy "
                        "fallback", e, detail.decode(errors="replace")[:500])
         return None
+    finally:
+        tmp.unlink(missing_ok=True)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -77,7 +84,15 @@ def _load() -> Optional[ctypes.CDLL]:
         if path is None:
             _build_failed = True
             return None
-        lib = ctypes.CDLL(str(path))
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError as e:
+            # A corrupt/foreign .so must degrade to the numpy fallback, not
+            # propagate out of the data pipeline.
+            logger.warning("loading native loader %s failed (%s); using "
+                           "numpy fallback", path, e)
+            _build_failed = True
+            return None
         lib.tpu_dist_gather_scale_u8_f32.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_float, ctypes.c_void_p, ctypes.c_int]

@@ -391,14 +391,19 @@ class Residual(Layer):
         return params, state, out_main
 
     def apply(self, params, state, x, *, training=False, rng=None):
+        # Distinct rng per branch: a Dropout at position i of each branch must
+        # not draw the same fold_in(rng, i) key (correlated masks otherwise).
+        rng_main = rng_short = None
+        if rng is not None:
+            rng_main, rng_short = jax.random.split(rng)
         y, s_main = apply_chain(
             self.main, self._main_names, params.get("main", {}),
             state.get("main", {}) if state else {}, x,
-            training=training, rng=rng)
+            training=training, rng=rng_main)
         sc, s_short = apply_chain(
             self.shortcut, self._short_names, params.get("shortcut", {}),
             state.get("shortcut", {}) if state else {}, x,
-            training=training, rng=rng)
+            training=training, rng=rng_short)
         new_state = {}
         if s_main:
             new_state["main"] = s_main

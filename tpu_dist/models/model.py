@@ -154,6 +154,28 @@ class Model:
             self._trainer = Trainer(self)
         return self._trainer.predict(x)
 
+    def make_train_function(self, steps_per_execution: Optional[int] = None):
+        """The jitted SPMD train step (Keras-2 name; SURVEY.md D15) — see
+        ``Trainer.make_train_function`` for the callable's contract."""
+        from tpu_dist.training.trainer import Trainer
+
+        if self.loss is None or self.optimizer is None:
+            raise RuntimeError(
+                f"{self.name} must be compile()d with a loss and optimizer "
+                "before make_train_function()")
+        if self._trainer is None:
+            self._trainer = Trainer(self)
+        return self._trainer.make_train_function(steps_per_execution)
+
+    def train_state(self) -> tuple:
+        """Fresh ``(params, state, opt, metrics, loss_acc)`` for the
+        ``make_train_function`` callable."""
+        from tpu_dist.training.trainer import Trainer
+
+        if self._trainer is None:
+            self._trainer = Trainer(self)
+        return self._trainer.train_state()
+
     @property
     def variables(self) -> Optional[Variables]:
         """Live training variables, once fit/evaluate has materialized them."""

@@ -214,6 +214,41 @@ class Trainer:
             donate_argnums=(0, 1, 2, 3, 4),
         )
 
+    def make_train_function(self, steps_per_execution: Optional[int] = None):
+        """The compiled train step — public surface for benchmarks and custom
+        loops (the Keras-2 ``make_train_function`` analog, SURVEY.md D15).
+
+        With ``steps_per_execution`` (default: the model's compiled value) of
+        1, returns the jitted single step::
+
+            fn(params, state, opt, metrics, loss_acc, x, y, rng)
+              -> (loss, params, state, opt, metrics, loss_acc)
+
+        With K > 1, returns the scanned multi-step, whose ``x``/``y``/``rng``
+        carry a leading K axis (stack K batches; see ``jnp_stack_keys``) and
+        whose loss is the K-mean. Both donate their variable arguments —
+        callers must thread the returned state into the next call.
+        """
+        self.ensure_variables()
+        self._maybe_invalidate_for_policy()
+        k = (steps_per_execution if steps_per_execution is not None
+             else max(1, int(getattr(self.model, "steps_per_execution", 1))))
+        if k > 1:
+            if self._multi_step is None:
+                self._multi_step = self._build_multi_step()
+            return self._multi_step
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step
+
+    def train_state(self) -> tuple:
+        """A fresh ``(params, state, opt, metrics, loss_acc)`` tuple, in the
+        positional order the ``make_train_function`` callable consumes."""
+        self.ensure_variables()
+        v = self.variables
+        return (v["params"], v["state"], v["opt"], v["metrics"],
+                self._init_loss_acc())
+
     def _build_eval_step(self):
         model, loss_obj = self.model, self.model.loss
         metrics = tuple(model.metrics)
@@ -300,7 +335,18 @@ class Trainer:
                             "epoch %d", restored, initial_epoch)
             except FileNotFoundError:
                 pass
-            callbacks.append(ModelCheckpoint(checkpoint_dir))
+            # Don't double up save+barrier work if the caller already passed
+            # a ModelCheckpoint for this same directory (str/Path agnostic).
+            import os as _os
+
+            def _same_dir(cb):
+                d = getattr(cb, "directory", None)
+                return (d is not None
+                        and _os.fspath(d) == _os.fspath(checkpoint_dir))
+
+            if not any(isinstance(cb, ModelCheckpoint) and _same_dir(cb)
+                       for cb in callbacks):
+                callbacks.append(ModelCheckpoint(checkpoint_dir))
 
         val_dist = val_steps = None
         if validation_data is not None:
@@ -452,10 +498,13 @@ class Trainer:
         loss_acc = self.strategy.replicate(
             (np.float32(0.0), np.float32(0.0)), broadcast=False)
         count = 0
-        for batch in dist:
-            if steps is not None and count >= steps:
-                break
-            xb, yb = batch
+        # islice stops BEFORE pulling batch steps+1 — a plain for-loop with a
+        # break-on-count would do one extra batch of host pipeline work per
+        # bounded pass only to discard it.
+        import itertools
+
+        bounded = dist if steps is None else itertools.islice(iter(dist), steps)
+        for xb, yb in bounded:
             metric_states, loss_acc = self._eval_step(
                 v["params"], v["state"], metric_states, loss_acc, xb, yb)
             count += 1
