@@ -1,0 +1,199 @@
+"""AutoShardPolicy.FILE: file-backed sources, chain rewrite, AUTO preference.
+
+The reference commits to TF's full AutoShardPolicy enum (SURVEY.md D13;
+tf:python/data/ops/options.py:89-116). FILE shards the SOURCE FILES across
+workers (worker i reads files i, i+n, ...) via a rewrite that pushes the shard
+down to the file reader (auto_shard.cc); AUTO prefers FILE when the source has
+enough files and falls back to DATA otherwise.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_dist.data.pipeline import AutoShardPolicy, Dataset, Options
+from tpu_dist.data.sharding import resolve_policy, shard_dataset
+from tpu_dist.data import sources
+
+
+def _toy_arrays(n=48):
+    images = np.arange(n * 4, dtype=np.uint8).reshape(n, 2, 2, 1)
+    labels = (np.arange(n) % 10).astype(np.int64)
+    return images, labels
+
+
+@pytest.fixture
+def shard_dir(tmp_path, monkeypatch):
+    """Four mnist-train shard files under a fresh $TPU_DIST_DATA_DIR."""
+    images, labels = _toy_arrays()
+    sources.write_sharded(tmp_path, "mnist", "train", images, labels, 4)
+    monkeypatch.setenv(sources.DATA_DIR_ENV, str(tmp_path))
+    return tmp_path
+
+
+def _elements(ds):
+    return [(int(x.reshape(-1)[0]), int(y)) for x, y in ds]
+
+
+class TestFromFiles:
+    def test_reads_all_files_in_order(self, tmp_path):
+        for i in range(3):
+            np.save(tmp_path / f"f{i}.npy", np.arange(i * 10, i * 10 + 5))
+        files = sorted(tmp_path.glob("f*.npy"))
+        ds = Dataset.from_files(files, lambda p: iter(np.load(p)))
+        assert ds.num_files == 3
+        got = [int(v) for v in ds]
+        assert got == [*range(0, 5), *range(10, 15), *range(20, 25)]
+
+    def test_empty_file_list_raises(self):
+        with pytest.raises(ValueError):
+            Dataset.from_files([], lambda p: iter([]))
+
+
+class TestFileShard:
+    def test_strided_disjoint_union(self, shard_dir):
+        ds = sources.load("mnist", "train")
+        assert ds.num_files == 4
+        shards = [shard_dataset(ds, 2, i, AutoShardPolicy.FILE)
+                  for i in range(2)]
+        e0, e1 = _elements(shards[0]), _elements(shards[1])
+        assert not set(e0) & set(e1)
+        assert sorted(set(e0) | set(e1)) == sorted(_elements(ds))
+        # worker 0 gets files {0, 2}, worker 1 files {1, 3} (TF stride).
+        assert len(e0) == len(e1) == 24
+
+    def test_chain_rewrite_through_map_batch(self, shard_dir):
+        # The rewrite must replay map/cache downstream of the file stride.
+        ds = sources.load("mnist", "train").map(
+            lambda x, y: (x.astype(np.float32) / 255.0, y)).cache()
+        s0 = shard_dataset(ds, 4, 0, AutoShardPolicy.FILE)
+        got = list(s0)
+        assert len(got) == 12
+        assert got[0][0].dtype == np.float32
+
+    def test_pre_batched_rebatches_global_to_per_worker(self, shard_dir):
+        # experimental_distribute_dataset path: user batched to GLOBAL=24;
+        # each of 2 workers gets batches of 12 drawn from its own files.
+        ds = sources.load("mnist", "train").batch(24)
+        s0 = shard_dataset(ds, 2, 0, AutoShardPolicy.FILE, pre_batched=True)
+        batches = list(s0)
+        assert [b[0].shape[0] for b in batches] == [12, 12]
+        ids = {int(x.reshape(-1)[0]) for b in batches for x in b[0]}
+        s1 = shard_dataset(ds, 2, 1, AutoShardPolicy.FILE, pre_batched=True)
+        ids1 = {int(x.reshape(-1)[0]) for b in s1 for x in b[0]}
+        assert not ids & ids1
+
+    def test_rebatch_indivisible_raises(self, shard_dir):
+        ds = sources.load("mnist", "train").batch(25)
+        with pytest.raises(ValueError, match="not divisible"):
+            shard_dataset(ds, 2, 0, AutoShardPolicy.FILE, pre_batched=True)
+
+    def test_too_few_files_raises(self, shard_dir):
+        ds = sources.load("mnist", "train")  # 4 files
+        with pytest.raises(ValueError, match="FILE requires"):
+            shard_dataset(ds, 8, 0, AutoShardPolicy.FILE)
+
+    def test_in_memory_source_raises(self):
+        ds = Dataset.from_tensor_slices((np.zeros((8, 2)), np.zeros(8)))
+        with pytest.raises(ValueError):
+            shard_dataset(ds, 2, 0, AutoShardPolicy.FILE)
+
+    def test_cardinality_known_from_headers(self, shard_dir):
+        ds = sources.load("mnist", "train")
+        assert ds.cardinality() == 48
+
+    def test_sharded_subset_keeps_cardinality(self, shard_dir):
+        # fit(steps_per_epoch=None) relies on the sharded worker pipeline
+        # still knowing its size (per-file counts thread through the stride).
+        ds = sources.load("mnist", "train").batch(12)
+        s0 = shard_dataset(ds, 2, 0, AutoShardPolicy.FILE, pre_batched=True)
+        assert s0.cardinality() == 4  # 24 samples / per-worker batch 6 -> 4
+
+    def test_uneven_file_split_raises(self, shard_dir):
+        ds = sources.load("mnist", "train")  # 4 files
+        with pytest.raises(ValueError, match="evenly"):
+            shard_dataset(ds, 3, 0, AutoShardPolicy.FILE)
+
+    def test_stale_generation_not_mixed(self, shard_dir, tmp_path):
+        # Re-sharding with a different count leaves the old generation on
+        # disk; load must serve exactly ONE complete generation.
+        images, labels = _toy_arrays()
+        sources.write_sharded(tmp_path, "mnist", "train", images, labels, 8)
+        ds = sources.load("mnist", "train")
+        assert ds.num_files in (4, 8)
+        assert ds.cardinality() == 48  # every sample exactly once
+
+    def test_incomplete_generation_ignored(self, tmp_path, monkeypatch):
+        images, labels = _toy_arrays()
+        paths = sources.write_sharded(
+            tmp_path, "mnist", "train", images, labels, 4)
+        paths[2].unlink()  # break the generation
+        monkeypatch.setenv(sources.DATA_DIR_ENV, str(tmp_path))
+        ds = sources.load("mnist", "train", synthetic_size=16)
+        assert ds.num_files == 1  # fell back to the in-memory source
+
+
+class TestAutoPrefersFile:
+    def test_auto_resolves_file_when_enough_files(self, shard_dir):
+        ds = sources.load("mnist", "train")
+        assert resolve_policy(ds, 2, AutoShardPolicy.AUTO) == AutoShardPolicy.FILE
+        assert resolve_policy(ds, 4, AutoShardPolicy.AUTO) == AutoShardPolicy.FILE
+
+    def test_auto_falls_back_to_data_when_too_few_files(self, shard_dir):
+        ds = sources.load("mnist", "train")
+        assert resolve_policy(ds, 8, AutoShardPolicy.AUTO) == AutoShardPolicy.DATA
+
+    def test_auto_falls_back_to_data_when_uneven(self, shard_dir):
+        # 4 files over 3 workers would desync sync-SPMD; AUTO must pick DATA.
+        ds = sources.load("mnist", "train")
+        assert resolve_policy(ds, 3, AutoShardPolicy.AUTO) == AutoShardPolicy.DATA
+
+    def test_auto_falls_back_for_in_memory_source(self):
+        ds = Dataset.from_tensor_slices((np.zeros((8, 2)), np.zeros(8)))
+        assert resolve_policy(ds, 2, AutoShardPolicy.AUTO) == AutoShardPolicy.DATA
+
+    def test_auto_end_to_end_shards_by_file(self, shard_dir):
+        ds = sources.load("mnist", "train")
+        s0 = shard_dataset(ds, 2, 0, AutoShardPolicy.AUTO)
+        s1 = shard_dataset(ds, 2, 1, AutoShardPolicy.AUTO)
+        e0, e1 = set(_elements(s0)), set(_elements(s1))
+        assert not e0 & e1 and len(e0 | e1) == 48
+
+
+class TestDistributedPrefetchDefault:
+    def test_auto_wrap_prefetches_once(self):
+        from tpu_dist.data.distribute import DistributedDataset
+        from tpu_dist.parallel.strategy import MirroredStrategy
+
+        strategy = MirroredStrategy()
+        x = np.zeros((16, 2), np.float32)
+        y = np.zeros(16, np.int64)
+        plain = Dataset.from_tensor_slices((x, y)).batch(8)
+        dist = DistributedDataset(plain, strategy)
+        assert dist._local._transform == ("prefetch", {"buffer_size": 2})
+
+        already = plain.prefetch(3)
+        dist2 = DistributedDataset(already, strategy)
+        assert dist2._local is already  # no second wrap
+
+        # The marker survives further derivation (e.g. a post-prefetch map).
+        derived = already.map(lambda a, b: (a, b))
+        dist3 = DistributedDataset(derived, strategy)
+        assert dist3._local is derived
+
+
+class TestWriteSharded:
+    def test_roundtrip_preserves_all_samples(self, tmp_path):
+        images, labels = _toy_arrays(30)
+        sources.write_sharded(tmp_path, "cifar10", "test", images, labels, 3)
+        files = sorted(tmp_path.glob("cifar10-test.shard-*.npz"))
+        assert len(files) == 3
+        back = []
+        for p in files:
+            with np.load(p) as z:
+                back.extend(int(v) for v in z["labels"])
+        assert sorted(back) == sorted(int(v) for v in labels)
+
+    def test_bad_shard_count_raises(self, tmp_path):
+        images, labels = _toy_arrays(4)
+        with pytest.raises(ValueError):
+            sources.write_sharded(tmp_path, "mnist", "train", images, labels, 9)

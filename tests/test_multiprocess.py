@@ -96,6 +96,55 @@ emit({"process_index": jax.process_index(), "local_batches": batches})
         assert flat0.isdisjoint(flat1), (flat0, flat1)
 
 
+class TestFileShardingMultiProcess:
+    def test_file_policy_assigns_disjoint_files(self, tmp_path):
+        """AutoShardPolicy.FILE across real processes: each worker reads a
+        strided, disjoint subset of the shard files (SURVEY.md D13), and the
+        pre-batched global batch is rebatched to the per-worker size."""
+        import numpy as np
+
+        from tpu_dist.data import sources
+
+        n = 48
+        images = np.arange(n * 4, dtype=np.uint8).reshape(n, 2, 2, 1)
+        labels = (np.arange(n) % 10).astype(np.int64)
+        sources.write_sharded(tmp_path, "mnist", "train", images, labels, 4)
+
+        body = """
+import numpy as np
+import tpu_dist as td
+
+strategy = td.MultiWorkerMirroredStrategy()
+ds = td.data.load("mnist", "train")   # 4 shard files via $TPU_DIST_DATA_DIR
+assert ds.num_files == 4, ds.num_files
+opts = td.data.Options()
+opts.experimental_distribute.auto_shard_policy = td.AutoShardPolicy.FILE
+ds = ds.batch(24).with_options(opts)
+dist = strategy.experimental_distribute_dataset(ds)
+ids = []
+for xb, yb in dist:
+    # Collect every sample's first pixel from this process's local shard.
+    ids.extend(int(v) for s in xb.addressable_shards
+               for v in np.asarray(s.data).reshape(len(s.data), -1)[:, 0])
+import jax
+emit({"process_index": jax.process_index(), "ids": sorted(ids),
+      "global_batch": int(xb.shape[0])})
+"""
+        results = run_workers(
+            body, num_workers=2,
+            extra_env={"TPU_DIST_DATA_DIR": str(tmp_path)})
+        assert_all_succeeded(results)
+        r0, r1 = (r.result for r in results)
+        ids0, ids1 = set(r0["ids"]), set(r1["ids"])
+        # Disjoint file subsets; together the full dataset.
+        assert ids0.isdisjoint(ids1), (sorted(ids0 & ids1))
+        assert len(ids0) == len(ids1) == n // 2
+        assert sorted(ids0 | ids1) == [(i * 4) % 256 for i in range(n)]
+        # Global batch stays the user's GLOBAL_BATCH_SIZE (24): each worker
+        # contributed its rebatched half (12).
+        assert r0["global_batch"] == 24
+
+
 class TestCheckpointMultiProcess:
     def test_chief_only_write_and_synced_restore(self, tmp_path):
         body = f"""

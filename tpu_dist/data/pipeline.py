@@ -98,9 +98,20 @@ class Dataset:
         self._it_factory = it_factory
         self._options = options or Options()
         self._cardinality = cardinality
+        self._prefetched = False  # set by prefetch(); read by DistributedDataset
         #: Source-file count, drives AutoShardPolicy.FILE/AUTO decisions
         #: (TF autoshards by file when the source has files, auto_shard.cc).
         self.num_files = num_files
+        # Chain-rewrite metadata (the FILE-autoshard path, sharding.py): each
+        # derived dataset records its parent and a (name, kwargs) transform
+        # descriptor so the chain can be replayed onto a re-rooted source —
+        # the element-stream analog of TF's Grappler auto_shard graph rewrite
+        # pushing the shard op down to the file reader (auto_shard.cc).
+        self._parent: "Dataset | None" = None
+        self._transform: tuple[str, dict] | None = None
+        #: Set on file-backed sources (from_files): (num_shards, index) -> a
+        #: new source Dataset over the strided file subset.
+        self._file_shard_fn: Callable[[int, int], "Dataset"] | None = None
 
     # -- constructors --------------------------------------------------------
 
@@ -130,6 +141,50 @@ class Dataset:
         return Dataset(lambda: iter(gen_factory()))
 
     @staticmethod
+    def from_files(files: Sequence, reader: Callable[[Any], Iterable], *,
+                   cardinality: int | None = None,
+                   file_cardinalities: Sequence[int] | None = None) -> "Dataset":
+        """A file-backed source: elements are ``reader(file)``'s, file by file,
+        in the given order. This is the source shape AutoShardPolicy.FILE
+        strides across workers (SURVEY.md D13; TF shards the file list in
+        auto_shard.cc when the source is file-based).
+
+        ``file_cardinalities`` (per-file element counts, when known) lets a
+        FILE-sharded worker subset keep a known cardinality — without it the
+        subset's cardinality is unknown and ``fit`` needs an explicit
+        ``steps_per_epoch``."""
+        files = list(files)
+        if not files:
+            raise ValueError("from_files requires at least one file")
+        if file_cardinalities is not None:
+            file_cardinalities = list(file_cardinalities)
+            if len(file_cardinalities) != len(files):
+                raise ValueError(
+                    f"file_cardinalities has {len(file_cardinalities)} "
+                    f"entries for {len(files)} files")
+            total = sum(file_cardinalities)
+            if cardinality is None:
+                cardinality = total
+            elif cardinality != total:
+                raise ValueError(
+                    f"cardinality {cardinality} != sum(file_cardinalities) "
+                    f"{total}")
+
+        def factory():
+            for f in files:
+                yield from reader(f)
+
+        ds = Dataset(factory, cardinality=cardinality, num_files=len(files))
+        # TF strides the file list across workers (worker i reads files
+        # i, i+n, i+2n, ...); the subset source keeps its own file count and
+        # (when per-file counts are known) its own cardinality.
+        ds._file_shard_fn = lambda n, i: Dataset.from_files(
+            files[i::n], reader,
+            file_cardinalities=(None if file_cardinalities is None
+                                else file_cardinalities[i::n]))
+        return ds
+
+    @staticmethod
     def range(n: int) -> "Dataset":
         return Dataset(lambda: iter(range(n)), cardinality=n)
 
@@ -141,7 +196,7 @@ class Dataset:
             for el in self._it_factory():
                 yield fn(*el) if isinstance(el, tuple) else fn(el)
 
-        return self._derive(factory)
+        return self._derive(factory, transform=("map", {"fn": fn}))
 
     def filter(self, predicate: Callable) -> "Dataset":
         def factory():
@@ -150,7 +205,8 @@ class Dataset:
                 if keep:
                     yield el
 
-        return self._derive(factory, cardinality=None)
+        return self._derive(factory, cardinality=None,
+                            transform=("filter", {"predicate": predicate}))
 
     def cache(self) -> "Dataset":
         """Materialize on first full pass; later passes replay the cache
@@ -176,7 +232,7 @@ class Dataset:
                     store.extend(local)
                     complete.set()
 
-        return self._derive(factory)
+        return self._derive(factory, transform=("cache", {}))
 
     def shuffle(self, buffer_size: int, seed: int | None = None,
                 reshuffle_each_iteration: bool = True) -> "Dataset":
@@ -209,7 +265,11 @@ class Dataset:
             rng.shuffle(buf)
             yield from buf
 
-        return self._derive(factory)
+        return self._derive(
+            factory,
+            transform=("shuffle",
+                       {"buffer_size": buffer_size, "seed": seed,
+                        "reshuffle_each_iteration": reshuffle_each_iteration}))
 
     def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":
         if batch_size < 1:
@@ -229,7 +289,10 @@ class Dataset:
         if self._cardinality is not None:
             card = (self._cardinality // batch_size if drop_remainder
                     else -(-self._cardinality // batch_size))
-        return self._derive(factory, cardinality=card)
+        return self._derive(
+            factory, cardinality=card,
+            transform=("batch", {"batch_size": batch_size,
+                                 "drop_remainder": drop_remainder}))
 
     def repeat(self, count: int | None = None) -> "Dataset":
         def factory():
@@ -247,7 +310,8 @@ class Dataset:
         card = None
         if count is not None and self._cardinality is not None:
             card = count * self._cardinality
-        return self._derive(factory, cardinality=card)
+        return self._derive(factory, cardinality=card,
+                            transform=("repeat", {"count": count}))
 
     def take(self, count: int) -> "Dataset":
         def factory():
@@ -256,7 +320,8 @@ class Dataset:
         # Unknown source cardinality stays unknown: the source may yield fewer
         # than ``count`` elements (tf.data likewise keeps UNKNOWN_CARDINALITY).
         card = None if self._cardinality is None else min(count, self._cardinality)
-        return self._derive(factory, cardinality=card)
+        return self._derive(factory, cardinality=card,
+                            transform=("take", {"count": count}))
 
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Every ``num_shards``-th element starting at ``index`` — tf.data's
@@ -270,7 +335,9 @@ class Dataset:
         card = None
         if self._cardinality is not None:
             card = (self._cardinality - index + num_shards - 1) // num_shards
-        return self._derive(factory, cardinality=card)
+        return self._derive(factory, cardinality=card,
+                            transform=("shard", {"num_shards": num_shards,
+                                                 "index": index}))
 
     def prefetch(self, buffer_size: int = 2) -> "Dataset":
         """Background-thread prefetch, keeping host input off the step critical
@@ -320,12 +387,16 @@ class Dataset:
             finally:
                 stop.set()
 
-        return self._derive(factory)
+        ds = self._derive(factory,
+                          transform=("prefetch", {"buffer_size": buffer_size}))
+        ds._prefetched = True  # lets DistributedDataset skip double-wrapping
+        return ds
 
     def with_options(self, options: Options) -> "Dataset":
         """Attach options — the reference's auto-shard-policy carrier
         (tf_dist_example.py:37)."""
-        ds = self._derive(self._it_factory)
+        ds = self._derive(self._it_factory,
+                          transform=("with_options", {"options": options}))
         ds._options = options
         return ds
 
@@ -349,7 +420,8 @@ class Dataset:
     def as_numpy_iterator(self) -> Iterator:
         return iter(self)
 
-    def _derive(self, factory, cardinality: int | None = "inherit") -> "Dataset":  # type: ignore[assignment]
+    def _derive(self, factory, cardinality: int | None = "inherit",
+                transform: tuple[str, dict] | None = None) -> "Dataset":  # type: ignore[assignment]
         ds = Dataset(
             factory,
             options=self._options,
@@ -357,4 +429,17 @@ class Dataset:
                          else cardinality),
             num_files=self.num_files,
         )
+        ds._parent = self
+        ds._transform = transform
+        # A prefetch anywhere upstream keeps the chain marked, so the
+        # DistributedDataset default wrap never double-buffers.
+        ds._prefetched = self._prefetched
         return ds
+
+    def _replay_transform(self, transform: tuple[str, dict]) -> "Dataset":
+        """Apply a recorded (name, kwargs) transform descriptor to this
+        dataset — used by the FILE-autoshard chain rewrite (sharding.py)."""
+        name, kw = transform
+        if name == "with_options":
+            return self.with_options(kw["options"])
+        return getattr(self, name)(**kw)

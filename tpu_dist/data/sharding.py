@@ -11,9 +11,12 @@ index transformation — same contract, no graph rewriting:
 * DATA — each worker keeps every ``num_shards``-th element (applied pre-batch)
   or its contiguous 1/num_shards slice of each batch (applied post-batch, the
   rebatch path TF uses for pre-batched distributed datasets).
-* FILE — shard source files across workers; in-memory sources have one "file",
-  so explicit FILE over fewer files than workers raises (TF errors likewise),
-  while AUTO falls back to DATA with a warning (TF's fallback behavior).
+* FILE — re-root the combinator chain on a strided subset of the source's
+  files (worker i reads files i, i+n, ...), rebatching the final global batch
+  to the per-worker size on the pre-batched path; explicit FILE over fewer
+  files than workers (or a non-file source) raises (TF errors likewise),
+  while AUTO prefers FILE when applicable and falls back to DATA with a
+  warning (TF's fallback behavior).
 * HINT — treated as DATA (TF replaces SHARD_HINT placeholders with the
   worker's shard index).
 """
@@ -27,6 +30,34 @@ from tpu_dist.data.pipeline import AutoShardPolicy, Dataset
 logger = logging.getLogger("tpu_dist.data")
 
 
+def _source_of(dataset: Dataset) -> Dataset:
+    """Walk the combinator chain to its root source."""
+    d = dataset
+    while d._parent is not None:
+        d = d._parent
+    return d
+
+
+def _is_file_shardable(dataset: Dataset, num_shards: int) -> bool:
+    """FILE sharding applies iff the chain roots in a file-backed source with
+    enough files AND every link is replayable (records its transform)."""
+    d = dataset
+    while d._parent is not None:
+        if d._transform is None:
+            return False  # opaque derivation; cannot rewrite through it
+        d = d._parent
+    return (d._file_shard_fn is not None
+            and dataset.num_files >= num_shards)
+
+
+def _files_divide_evenly(dataset: Dataset, num_shards: int) -> bool:
+    """Synchronous SPMD needs every process in lockstep: an uneven file split
+    gives workers streams of different lengths, desyncing the per-step global
+    batch assembly. (TF tolerates unevenness because its per-worker iterators
+    are independent; our single-program model cannot.)"""
+    return dataset.num_files % num_shards == 0
+
+
 def resolve_policy(dataset: Dataset, num_shards: int,
                    policy: AutoShardPolicy | None = None) -> AutoShardPolicy:
     """Collapse AUTO/HINT into the concrete policy that will be applied."""
@@ -35,14 +66,19 @@ def resolve_policy(dataset: Dataset, num_shards: int,
     if policy == AutoShardPolicy.HINT:
         return AutoShardPolicy.DATA
     if policy == AutoShardPolicy.AUTO:
-        # FILE needs a file-backed source, which in-memory pipelines don't
-        # have yet — AUTO must always yield a working sharding, so it resolves
-        # to DATA unconditionally (TF's own AUTO falls back to DATA when file
-        # sharding isn't applicable).
-        if num_shards > 1 and dataset.num_files < num_shards:
-            logger.warning(
-                "AutoShardPolicy.AUTO: source has %d file(s) < %d workers; "
-                "falling back to DATA sharding", dataset.num_files, num_shards)
+        # TF's AUTO tries FILE first and falls back to DATA when the source
+        # isn't file-based or has too few files (auto_shard.cc fallback).
+        # Extra guard beyond TF: AUTO only picks FILE when the file count
+        # divides evenly — an uneven split would desync the sync-SPMD step.
+        if num_shards <= 1:
+            return AutoShardPolicy.DATA
+        if (_is_file_shardable(dataset, num_shards)
+                and _files_divide_evenly(dataset, num_shards)):
+            return AutoShardPolicy.FILE
+        logger.warning(
+            "AutoShardPolicy.AUTO: source has %d file(s) for %d workers "
+            "(not file-backed, too few, or not evenly divisible); falling "
+            "back to DATA sharding", dataset.num_files, num_shards)
         return AutoShardPolicy.DATA
     return policy
 
@@ -72,15 +108,65 @@ def shard_dataset(dataset: Dataset, num_shards: int, index: int,
                 f"AutoShardPolicy.FILE requires >= {num_shards} source files, "
                 f"dataset has {dataset.num_files}. Use DATA or OFF "
                 "(tf.data raises the same way when files < workers).")
-        raise NotImplementedError(
-            "FILE sharding requires a file-backed source; in-memory sources "
-            "expose one logical file. Multi-file sources arrive with the "
-            "sharded-input-file loader.")
+        if not _is_file_shardable(dataset, num_shards):
+            raise ValueError(
+                "AutoShardPolicy.FILE requires a file-backed source "
+                "(Dataset.from_files / sources.load over sharded files); "
+                "this pipeline roots in an in-memory source. Use DATA or OFF.")
+        if not _files_divide_evenly(dataset, num_shards):
+            # Deviation from TF (which lets some workers read more files):
+            # uneven per-worker streams desync synchronous SPMD training, so
+            # fail fast with the fix instead of hanging at a collective.
+            raise ValueError(
+                f"AutoShardPolicy.FILE: {dataset.num_files} files do not "
+                f"divide evenly over {num_shards} workers; synchronous "
+                "training requires equal-length worker streams. Re-shard the "
+                "source (sources.write_sharded) to a multiple of the worker "
+                "count, or use DATA.")
+        return _file_shard(dataset, num_shards, index, rebatch=pre_batched)
 
     assert concrete == AutoShardPolicy.DATA
     if pre_batched:
         return _slice_batches(dataset, num_shards, index)
     return dataset.shard(num_shards, index)
+
+
+def _file_shard(dataset: Dataset, num_shards: int, index: int,
+                *, rebatch: bool) -> Dataset:
+    """Re-root the combinator chain on a strided file subset — the
+    element-stream analog of TF's auto_shard graph rewrite pushing the shard
+    op down to the file reader (auto_shard.cc, SURVEY.md D13).
+
+    ``rebatch=True`` (the pre-batched ``experimental_distribute_dataset``
+    path) additionally rewrites the final ``batch(GLOBAL)`` into
+    ``batch(GLOBAL / num_shards)`` — TF's rebatch pass: the user batched to
+    the global size, but each worker now holds only its file slice.
+    """
+    transforms: list[tuple[str, dict]] = []
+    d = dataset
+    while d._parent is not None:
+        transforms.append(d._transform)  # validated by _is_file_shardable
+        d = d._parent
+    transforms.reverse()  # root-most first
+
+    if rebatch:
+        for i in range(len(transforms) - 1, -1, -1):
+            name, kw = transforms[i]
+            if name == "batch":
+                b = kw["batch_size"]
+                if b % num_shards:
+                    raise ValueError(
+                        f"global batch {b} not divisible by {num_shards} "
+                        "workers; make GLOBAL_BATCH_SIZE a multiple of the "
+                        "worker count (tf_dist_example.py:17-18 semantics)")
+                transforms[i] = ("batch", {**kw,
+                                           "batch_size": b // num_shards})
+                break
+
+    out = d._file_shard_fn(num_shards, index)
+    for t in transforms:
+        out = out._replay_transform(t)
+    return out
 
 
 def _slice_batches(dataset: Dataset, num_shards: int, index: int) -> Dataset:

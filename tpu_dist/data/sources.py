@@ -22,7 +22,7 @@ import os
 import pathlib
 import struct
 import zlib
-from typing import Mapping
+from typing import Iterable, Mapping
 
 import numpy as np
 
@@ -148,11 +148,106 @@ def load_arrays(name: str, split: str = "train", *,
     return _synthetic(name, split, synthetic_size)
 
 
+def _find_shard_files(name: str, split: str) -> list[pathlib.Path]:
+    """Sharded npz archives (``{name}-{split}.shard-00002-of-00008.npz``) in
+    the search dirs — the multi-file source shape AutoShardPolicy.FILE
+    strides across workers (SURVEY.md D13).
+
+    Files are grouped by their ``-of-NNNNN`` generation suffix and only a
+    COMPLETE generation is served (all NNNNN files present) — re-sharding the
+    same dataset with a different shard count leaves the old generation on
+    disk, and silently mixing generations would duplicate every sample. With
+    several complete generations, the most recently written wins."""
+    pattern = f"{name}-{split}.shard-*-of-*.npz"
+    for base in _search_dirs():
+        for sub in (base, base / name):
+            found = sorted(sub.glob(pattern)) if sub.is_dir() else []
+            if not found:
+                continue
+            groups: dict[int, list[pathlib.Path]] = {}
+            for p in found:
+                try:
+                    n = int(p.stem.rsplit("-of-", 1)[1])
+                except (IndexError, ValueError):
+                    continue
+                groups.setdefault(n, []).append(p)
+            complete = {n: fs for n, fs in groups.items() if len(fs) == n}
+            if not complete:
+                logger.warning(
+                    "shard files under %s form no complete generation "
+                    "(found %s); ignoring them",
+                    sub, {n: len(fs) for n, fs in groups.items()})
+                continue
+            if len(complete) > 1:
+                newest = max(
+                    complete,
+                    key=lambda n: max(p.stat().st_mtime for p in complete[n]))
+                logger.warning(
+                    "multiple complete shard generations for %s/%s under %s "
+                    "(%s); using the newest (-of-%05d)", name, split, sub,
+                    sorted(complete), newest)
+                return complete[newest]
+            return next(iter(complete.values()))
+    return []
+
+
+def _read_shard(path) -> "Iterable[tuple[np.ndarray, np.ndarray]]":
+    with np.load(path, allow_pickle=False) as z:
+        images, labels = z["images"], z["labels"]
+    for i in range(len(labels)):
+        yield images[i], np.int64(labels[i])
+
+
+def write_sharded(directory, name: str, split: str, images: np.ndarray,
+                  labels: np.ndarray, num_shards: int) -> list[pathlib.Path]:
+    """Split (images, labels) into ``num_shards`` npz shard files that
+    ``load`` discovers and serves as a file-backed Dataset — the preparation
+    step for AutoShardPolicy.FILE jobs (each worker then reads a disjoint
+    file subset)."""
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    if not 1 <= num_shards <= len(labels):
+        raise ValueError(
+            f"num_shards must be in [1, {len(labels)}], got {num_shards}")
+    paths = []
+    for s in range(num_shards):
+        p = directory / f"{name}-{split}.shard-{s:05d}-of-{num_shards:05d}.npz"
+        np.savez(p, images=images[s::num_shards], labels=labels[s::num_shards])
+        paths.append(p)
+    logger.info("wrote %d shard files for %s/%s under %s",
+                num_shards, name, split, directory)
+    return paths
+
+
 def load(name: str, split: str = "train", *, as_supervised: bool = True,
          synthetic_size: int | None = None) -> Dataset:
     """tfds.load-shaped entry point (tf_dist_example.py:15 usage):
     ``load('mnist', split='train', as_supervised=True)`` yields
-    ``(image, label)`` tuples; ``as_supervised=False`` yields dicts."""
+    ``(image, label)`` tuples; ``as_supervised=False`` yields dicts.
+
+    If sharded npz files exist (see :func:`write_sharded`), the result is a
+    file-backed Dataset (``num_files > 1``) eligible for
+    AutoShardPolicy.FILE/AUTO file-level sharding across workers."""
+    if name not in _SPECS:
+        raise ValueError(f"unknown dataset {name!r}; available: {sorted(_SPECS)}")
+    shards = _find_shard_files(name, split)
+    if shards:
+        # Per-file cardinality from the shard headers: npz loads lazily
+        # per-array, so counting labels is cheap; fit() gets a known
+        # steps_per_epoch even after FILE sharding strides the file list.
+        counts = []
+        for p in shards:
+            with np.load(p, allow_pickle=False) as z:
+                counts.append(len(z["labels"]))
+        logger.info("loaded %s/%s from %d shard file(s) (%d samples)",
+                    name, split, len(shards), sum(counts))
+        if as_supervised:
+            return Dataset.from_files(shards, _read_shard,
+                                      file_cardinalities=counts)
+        return Dataset.from_files(
+            shards,
+            lambda p: ({"image": x, "label": y} for x, y in _read_shard(p)),
+            file_cardinalities=counts)
     x, y = load_arrays(name, split, synthetic_size=synthetic_size)
     if as_supervised:
         ds = Dataset.from_tensor_slices((x, y))
