@@ -1,0 +1,99 @@
+"""Micro-benchmark: Pallas fused sparse-CE vs the plain jnp path (VERDICT r1
+item 7 — prove or drop). Runs on the current backend (meaningful on TPU).
+
+    python benchmarks/pallas_ce_bench.py
+
+Prints one JSON line per (batch, classes) shape with fwd and fwd+bwd timings
+for both implementations, and writes benchmarks/pallas_ce_results.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_one(b: int, c: int, repeats: int = 200) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.ops.losses import sparse_categorical_crossentropy
+    from tpu_dist.ops.pallas_kernels import fused_sparse_cross_entropy
+
+    key = jax.random.PRNGKey(0)
+    logits = jax.device_put(
+        jax.random.normal(key, (b, c), jnp.float32).block_until_ready())
+    labels = jax.device_put(
+        np.random.default_rng(0).integers(0, c, b).astype(np.int32))
+
+    fused_f = jax.jit(lambda lg, lb: fused_sparse_cross_entropy(lg, lb).mean())
+    plain_f = jax.jit(lambda lg, lb: sparse_categorical_crossentropy(
+        lg, lb, from_logits=True).mean())
+    fused_g = jax.jit(jax.value_and_grad(
+        lambda lg, lb: fused_sparse_cross_entropy(lg, lb).mean()))
+    plain_g = jax.jit(jax.value_and_grad(
+        lambda lg, lb: sparse_categorical_crossentropy(
+            lg, lb, from_logits=True).mean()))
+
+    def timeit(fn):
+        out = fn(logits, labels)
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                out = fn(logits, labels)
+            jax.block_until_ready(out)
+            best = min(best, (time.perf_counter() - t0) / repeats)
+        return best * 1e6  # us
+
+    # Numerical agreement first — a fast wrong kernel is worthless.
+    lf, lp = fused_f(logits, labels), plain_f(logits, labels)
+    np.testing.assert_allclose(float(lf), float(lp), rtol=1e-5)
+    (vf, gf), (vp, gp) = fused_g(logits, labels), plain_g(logits, labels)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gp),
+                               rtol=1e-4, atol=1e-6)
+
+    import jax as _jax
+    row = {
+        "platform": _jax.devices()[0].platform,
+        "batch": b,
+        "classes": c,
+        "fwd_us": {"fused": round(timeit(fused_f), 2),
+                   "jnp": round(timeit(plain_f), 2)},
+        "fwd_bwd_us": {"fused": round(timeit(fused_g), 2),
+                       "jnp": round(timeit(plain_g), 2)},
+    }
+    row["fwd_speedup"] = round(row["fwd_us"]["jnp"] / row["fwd_us"]["fused"], 3)
+    row["fwd_bwd_speedup"] = round(
+        row["fwd_bwd_us"]["jnp"] / row["fwd_bwd_us"]["fused"], 3)
+    return row
+
+
+def main() -> int:
+    shapes = [(128, 10), (1024, 10), (1024, 1024), (8192, 1024), (4096, 32768)]
+    rows = []
+    for b, c in shapes:
+        try:
+            row = bench_one(b, c)
+        except Exception as e:
+            row = {"batch": b, "classes": c,
+                   "error": f"{type(e).__name__}: {e}"[:300]}
+        rows.append(row)
+        print(json.dumps(row))
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "pallas_ce_results.json")
+    with open(out_path, "w") as f:
+        json.dump(rows, f, indent=2)
+    print(f"wrote {out_path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,224 @@
+"""DeviceDataset: device-resident input with on-device batch assembly.
+
+The TPU-native input path for HBM-sized datasets (device.py): one upload,
+per-step batches gathered on device from host-generated shuffled indices.
+Must compose with fit/evaluate, steps_per_execution, and the mesh sharding
+invariants (batch dim sharded over the data axis, source replicated).
+"""
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.data.device import DeviceDataset, device_pipeline
+
+
+def _toy(n=256):
+    x = np.arange(n * 4, dtype=np.uint8).reshape(n, 2, 2, 1)
+    y = (np.arange(n) % 10).astype(np.int64)
+    return x, y
+
+
+@pytest.fixture
+def strategy():
+    return td.MirroredStrategy()
+
+
+class TestConstruction:
+    def test_batch_exceeding_size_raises(self):
+        x, y = _toy(16)
+        with pytest.raises(ValueError, match="exceeds"):
+            DeviceDataset(x, y, global_batch_size=32)
+
+    def test_mismatched_lengths_raise(self):
+        x, y = _toy(16)
+        with pytest.raises(ValueError, match="disagree"):
+            DeviceDataset(x, y[:-1], global_batch_size=8)
+
+    def test_indivisible_batch_raises_on_placement(self, strategy):
+        x, y = _toy(64)
+        ds = DeviceDataset(x, y, global_batch_size=12, strategy=strategy)
+        with pytest.raises(ValueError, match="not divisible"):
+            ds.next_batch()
+
+    def test_cardinality_drop_remainder(self, strategy):
+        x, y = _toy(100)
+        ds = DeviceDataset(x, y, global_batch_size=32, strategy=strategy)
+        assert ds.cardinality() == 3
+
+
+class TestSharding:
+    def test_batch_sharded_over_mesh(self, strategy, eight_devices):
+        x, y = _toy()
+        ds = DeviceDataset(x, y, global_batch_size=64, strategy=strategy)
+        xb, yb = ds.next_batch()
+        assert xb.shape == (64, 2, 2, 1) and xb.dtype == np.float32
+        assert len(xb.sharding.device_set) == 8
+        assert yb.shape == (64,)
+
+    def test_stack_layout(self, strategy):
+        x, y = _toy()
+        ds = DeviceDataset(x, y, global_batch_size=32, strategy=strategy)
+        xb, yb = ds.next_stack(4)
+        assert xb.shape == (4, 32, 2, 2, 1)
+        assert yb.shape == (4, 32)
+
+    def test_source_stays_uint8_on_device(self, strategy):
+        x, y = _toy()
+        ds = DeviceDataset(x, y, global_batch_size=32, strategy=strategy)
+        ds.next_batch()
+        assert ds._dx.dtype == np.uint8  # 4x HBM saving vs float32
+
+    def test_scale_applied(self, strategy):
+        x, y = _toy()
+        ds = DeviceDataset(x, y, global_batch_size=32, strategy=strategy,
+                           shuffle=False, scale=1.0 / 255.0)
+        xb, _ = ds.next_batch()
+        np.testing.assert_allclose(
+            np.asarray(xb[0]), x[0].astype(np.float32) / 255.0, rtol=1e-6)
+
+    def test_scale_none_passthrough(self, strategy):
+        x, y = _toy()
+        ds = DeviceDataset(x, y, global_batch_size=32, strategy=strategy,
+                           shuffle=False, scale=None)
+        xb, _ = ds.next_batch()
+        assert xb.dtype == np.uint8
+
+
+class TestShuffleSemantics:
+    def test_epoch_covers_all_samples_once(self, strategy):
+        x, y = _toy(64)
+        ds = DeviceDataset(x, y, global_batch_size=16, strategy=strategy,
+                           seed=7)
+        seen = []
+        for _ in range(ds.cardinality()):
+            _, yb = ds.next_batch()
+            seen.extend(int(v) for v in np.asarray(yb))
+        assert sorted(seen) == sorted(int(v) for v in y)
+
+    def test_reshuffles_each_epoch(self, strategy):
+        x, y = _toy(64)
+        ds = DeviceDataset(x, y, global_batch_size=64, strategy=strategy,
+                           seed=7)
+        _, e0 = ds.next_batch()
+        _, e1 = ds.next_batch()  # second epoch (one batch per epoch)
+        assert not np.array_equal(np.asarray(e0), np.asarray(e1))
+
+    def test_seed_determinism(self, strategy):
+        x, y = _toy(64)
+        a = DeviceDataset(x, y, global_batch_size=16, strategy=strategy, seed=3)
+        b = DeviceDataset(x, y, global_batch_size=16, strategy=strategy, seed=3)
+        _, ya = a.next_batch()
+        _, yb = b.next_batch()
+        np.testing.assert_array_equal(np.asarray(ya), np.asarray(yb))
+
+    def test_iter_is_sequential_unshuffled(self, strategy):
+        x, y = _toy(64)
+        ds = DeviceDataset(x, y, global_batch_size=16, strategy=strategy)
+        got = [int(v) for _, yb in ds for v in np.asarray(yb)]
+        assert got == [int(v) for v in y]
+
+
+class TestFitIntegration:
+    def test_fit_converges_and_infers_steps(self, strategy):
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model(learning_rate=0.05)
+        ds = device_pipeline("mnist", global_batch_size=64,
+                             synthetic_size=512)
+        hist = model.fit(ds, epochs=3, verbose=0)  # steps from cardinality
+        losses = hist.history["loss"]
+        assert len(losses) == 3
+        assert losses[-1] < losses[0]
+
+    def test_fit_with_steps_per_execution(self, strategy):
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model(learning_rate=0.05)
+            model.compile(
+                loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+                optimizer=td.ops.SGD(learning_rate=0.05),
+                metrics=[td.ops.SparseCategoricalAccuracy()],
+                steps_per_execution=4,
+            )
+        ds = device_pipeline("mnist", global_batch_size=64,
+                             synthetic_size=512)
+        # 6 steps = one K=4 execution + one K=2 tail execution.
+        hist = model.fit(ds, epochs=2, steps_per_epoch=6, verbose=0)
+        assert len(hist.history["loss"]) == 2
+        assert np.isfinite(hist.history["loss"][-1])
+
+    def test_fit_binds_dataset_built_outside_scope(self, strategy):
+        # Built with no strategy, before the scope: fit must re-home it onto
+        # the model's mesh.
+        ds = device_pipeline("mnist", global_batch_size=64,
+                             synthetic_size=512)
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model(learning_rate=0.05)
+        hist = model.fit(ds, epochs=1, steps_per_epoch=4, verbose=0)
+        assert np.isfinite(hist.history["loss"][0])
+        xb, _ = ds.next_batch()
+        assert len(xb.sharding.device_set) == 8
+
+    def test_evaluate_on_device_dataset(self, strategy):
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model(learning_rate=0.05)
+        train = device_pipeline("mnist", global_batch_size=64,
+                                synthetic_size=512)
+        model.fit(train, epochs=2, verbose=0)
+        test = device_pipeline("mnist", global_batch_size=64, split="test",
+                               synthetic_size=256)
+        logs = model.evaluate(test, verbose=0)
+        assert set(logs) == {"loss", "accuracy"}
+        assert np.isfinite(logs["loss"])
+
+    def test_validation_data_device_dataset(self, strategy):
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model(learning_rate=0.05)
+        train = device_pipeline("mnist", global_batch_size=64,
+                                synthetic_size=512)
+        val = device_pipeline("mnist", global_batch_size=64, split="test",
+                              synthetic_size=128)
+        hist = model.fit(train, epochs=2, steps_per_epoch=4,
+                         validation_data=val, verbose=0)
+        assert "val_loss" in hist.history
+        assert len(hist.history["val_loss"]) == 2
+
+    def test_equivalent_to_host_pipeline_step(self, strategy):
+        # One train step from the device path must equal one from the host
+        # path on the same batch (same params, same rng): the gather+scale
+        # on device IS the reference's map(scale)+batch composition.
+        import jax
+
+        x, y = _toy(64)
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model(learning_rate=0.05)
+
+        dsd = DeviceDataset(x, y, global_batch_size=32, strategy=strategy,
+                            shuffle=False, scale=1.0 / 255.0)
+
+        def fresh_model():
+            # Same seed -> identical init; the step donates its state, so
+            # each invocation gets its own model instance.
+            with strategy.scope():
+                m = td.models.Sequential([
+                    td.models.layers.Flatten(),
+                    td.models.layers.Dense(10),
+                ], input_shape=(2, 2, 1))
+                m.compile(
+                    loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+                    optimizer=td.ops.SGD(learning_rate=0.1))
+            return m
+
+        key = jax.random.PRNGKey(0)
+        m1 = fresh_model()
+        xb_dev, yb_dev = dsd.next_batch()
+        loss_dev = m1.make_train_function()(
+            *m1.train_state(), xb_dev, yb_dev, key)[0]
+
+        m2 = fresh_model()
+        xb_host = x[:32].astype(np.float32) / 255.0
+        yb_host = y[:32]
+        loss_host = m2.make_train_function()(
+            *m2.train_state(), strategy.distribute_batch(xb_host),
+            strategy.distribute_batch(yb_host), key)[0]
+        np.testing.assert_allclose(float(loss_dev), float(loss_host),
+                                   rtol=1e-6)

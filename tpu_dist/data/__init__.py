@@ -9,8 +9,13 @@ from tpu_dist.data.sources import (
 )
 from tpu_dist.data.sharding import resolve_policy, shard_dataset
 from tpu_dist.data.distribute import DistributedDataset
+from tpu_dist.data.device import DeviceDataset, device_pipeline
+from tpu_dist.data.sources import write_sharded
 
 __all__ = [
+    "DeviceDataset",
+    "device_pipeline",
+    "write_sharded",
     "AutoShardPolicy",
     "Dataset",
     "Options",

@@ -1,0 +1,214 @@
+"""Device-resident datasets: upload once, assemble every batch ON device.
+
+TPU-native input delivery for datasets that fit in HBM (MNIST is 47 MB,
+CIFAR-10 157 MB as uint8 — trivial next to 16 GB): the whole dataset is
+placed on the mesh once (replicated), and each training step's batch is
+gathered on device by a tiny jitted ``take`` driven by host-generated
+shuffled indices. Per step, the host transfers ONLY the index vector
+(kilobytes), never the pixels.
+
+Why this exists (SURVEY.md hard-part #5, §3.4): the reference keeps input off
+the critical path with ``cache()`` + host prefetch, which is the right design
+when host->device DMA is cheap. On TPU — and especially through a tunneled
+runtime — per-step bulk H2D transfers dominate the step itself (measured here:
+a 6.4 MB stacked batch costs 100-800 ms interleaved with training dispatches,
+vs ~0.4 ms of compute per step). Caching device-side is the idiomatic fix:
+same composition semantics (map/scale, per-epoch reshuffle, batch), one
+transfer total.
+
+Semantics: equivalent to the reference pipeline
+``load(name).map(scale).cache().shuffle(FULL).batch(B, drop_remainder=True)``
+with a SEEDED per-epoch reshuffle shared by all processes — i.e. the
+single-program Mirrored semantic: one global permutation, every replica
+taking its shard of each global batch (SURVEY.md D14).
+
+    ds = device_pipeline("mnist", global_batch_size=128)
+    model.fit(ds, epochs=10, steps_per_epoch=20)
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator, Optional
+
+import numpy as np
+
+logger = logging.getLogger("tpu_dist.data")
+
+
+class DeviceDataset:
+    """A device-resident (images, labels) dataset with on-device batching.
+
+    ``fit``/``evaluate`` recognize this type and pull device-ready batches
+    from it directly (no host pipeline, no per-step bulk transfer).
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray, *,
+                 global_batch_size: int, strategy=None, seed: int = 0,
+                 shuffle: bool = True, scale: Optional[float] = 1.0 / 255.0):
+        n = len(images)
+        if len(labels) != n:
+            raise ValueError(f"images/labels disagree: {n} vs {len(labels)}")
+        if global_batch_size > n:
+            raise ValueError(
+                f"batch {global_batch_size} exceeds dataset size {n}")
+        self._host_x = np.ascontiguousarray(images)
+        self._host_y = np.ascontiguousarray(labels.astype(np.int64))
+        self._n = n
+        self._batch = int(global_batch_size)
+        self._seed = seed
+        self._shuffle = shuffle
+        self._scale = None if scale is None else float(scale)
+        self._strategy = strategy  # None => bind to fit()'s strategy lazily
+        self._dx = self._dy = None
+        self._epoch = 0
+        self._perm: Optional[np.ndarray] = None
+        self._pos = 0
+        self._gather_batch = None
+        self._gather_stack = None
+
+    def bind_strategy(self, strategy) -> "DeviceDataset":
+        """Pin (or re-pin) the mesh this dataset lives on. ``fit`` calls this
+        with the model's strategy, so a dataset built outside
+        ``strategy.scope()`` still lands on the training mesh; rebinding to a
+        different strategy re-uploads from the kept host arrays."""
+        if strategy is None or strategy is self._strategy:
+            return self
+        if self._strategy is not None and self._dx is not None:
+            logger.info("DeviceDataset: re-homing onto a different strategy "
+                        "(%d replicas)", strategy.num_replicas_in_sync)
+        self._strategy = strategy
+        self._dx = self._dy = None
+        self._gather_batch = None
+        self._gather_stack = None
+        return self
+
+    def _ensure_placed(self) -> None:
+        """Upload once onto the bound strategy's mesh, replicated (identical
+        source arrays on every process — sources.py is deterministic per
+        (name, split)). Kept in the source dtype (uint8 for image archives):
+        4x less HBM than float32; cast+scale runs inside the gather program."""
+        if self._dx is not None:
+            return
+        from tpu_dist.parallel import mesh as mesh_lib
+        from tpu_dist.parallel.strategy import get_strategy
+
+        if self._strategy is None:
+            self._strategy = get_strategy()
+        n_dev = self._strategy.num_replicas_in_sync
+        if self._batch % n_dev:
+            raise ValueError(
+                f"global batch {self._batch} not divisible by {n_dev} "
+                "devices")
+        self._mesh = self._strategy.mesh
+        self._axis = self._strategy.data_axis
+        self._dx, self._dy = mesh_lib.replicate(
+            (self._host_x, self._host_y), self._mesh)
+
+    # -- introspection (Dataset-compatible surface) ---------------------------
+
+    def cardinality(self) -> int:
+        """Batches per epoch (drop-remainder: device shapes are static)."""
+        return self._n // self._batch
+
+    @property
+    def global_batch_size(self) -> int:
+        return self._batch
+
+    @property
+    def element_spec(self):
+        return (self._host_x.shape[1:], self._host_y.shape[1:])
+
+    # -- gather programs ------------------------------------------------------
+
+    def _build_gather(self, stacked: bool):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        scale = self._scale
+        spec = (PartitionSpec(None, self._axis) if stacked
+                else PartitionSpec(self._axis))
+        out_sh = NamedSharding(self._mesh, spec)
+
+        def gather(dx, dy, idx):
+            xb = jnp.take(dx, idx, axis=0)
+            if scale is not None:
+                xb = xb.astype(jnp.float32) * scale
+            return xb, jnp.take(dy, idx, axis=0)
+
+        return jax.jit(gather, out_shardings=(out_sh, out_sh))
+
+    # The host index vector is passed to the gather jit AS NUMPY: every
+    # process computes the same seeded permutation, so jit treats it as
+    # replicated and the SPMD partitioner lets each device gather only its
+    # output shard's rows. (An explicit device_put with a NamedSharding was
+    # measured ~10x slower per execution on the tunneled TPU runtime; the
+    # plain dispatch-time transfer of a few KB is the fast path.)
+
+    # -- iteration ------------------------------------------------------------
+
+    def _next_indices(self, count: int) -> np.ndarray:
+        """``count`` sample indices, continuing the per-epoch permutation
+        (fresh seeded reshuffle per pass — tf.data reshuffle semantics with a
+        shared seed, so every process agrees)."""
+        out = np.empty(count, dtype=np.int32)
+        filled = 0
+        while filled < count:
+            if self._perm is None or self._pos >= (
+                    self.cardinality() * self._batch):
+                if self._shuffle:
+                    rng = np.random.default_rng(self._seed + self._epoch)
+                    self._perm = rng.permutation(self._n).astype(np.int32)
+                else:
+                    self._perm = np.arange(self._n, dtype=np.int32)
+                self._epoch += 1
+                self._pos = 0
+            take = min(count - filled,
+                       self.cardinality() * self._batch - self._pos)
+            out[filled:filled + take] = self._perm[self._pos:self._pos + take]
+            filled += take
+            self._pos += take
+        return out
+
+    def next_batch(self):
+        """One device-resident global batch: (images, labels), batch dim
+        sharded over the mesh data axis."""
+        self._ensure_placed()
+        if self._gather_batch is None:
+            self._gather_batch = self._build_gather(stacked=False)
+        idx = self._next_indices(self._batch)
+        return self._gather_batch(self._dx, self._dy, idx)
+
+    def next_stack(self, k: int):
+        """K stacked device batches [K, B, ...] for one multi-step
+        (steps_per_execution) execution."""
+        self._ensure_placed()
+        if self._gather_stack is None:
+            self._gather_stack = self._build_gather(stacked=True)
+        idx = self._next_indices(k * self._batch).reshape(k, self._batch)
+        return self._gather_stack(self._dx, self._dy, idx)
+
+    def __iter__(self) -> Iterator:
+        """One sequential, unshuffled pass — the evaluate() path."""
+        self._ensure_placed()
+        if self._gather_batch is None:
+            self._gather_batch = self._build_gather(stacked=False)
+        for s in range(self.cardinality()):
+            idx = np.arange(s * self._batch, (s + 1) * self._batch,
+                            dtype=np.int32)
+            yield self._gather_batch(self._dx, self._dy, idx)
+
+
+def device_pipeline(name: str, *, global_batch_size: int, seed: int = 0,
+                    split: str = "train", scale: float = 1.0 / 255.0,
+                    shuffle: bool = True, strategy=None,
+                    synthetic_size: int | None = None) -> DeviceDataset:
+    """A :class:`DeviceDataset` over a named source (sources.py resolution:
+    local files, else deterministic synthetic)."""
+    from tpu_dist.data.sources import load_arrays
+
+    images, labels = load_arrays(name, split, synthetic_size=synthetic_size)
+    return DeviceDataset(images, labels, global_batch_size=global_batch_size,
+                         strategy=strategy, seed=seed, shuffle=shuffle,
+                         scale=scale)

@@ -167,6 +167,16 @@ def fused_sparse_cross_entropy(logits, labels, *,
     ``logits``. On non-TPU backends (and for ragged shapes Pallas can't tile)
     this is the plain jnp computation — bit-comparable results either way.
     ``interpret=True`` forces the Pallas interpreter (CPU-testable path).
+
+    Measured on a v5e chip (benchmarks/pallas_ce_bench.py, r2): the fused
+    FORWARD beats XLA's fusion by 1.11-1.41x across (128..8192) x (10..1024);
+    the fwd+bwd pair only breaks even at the largest shape (1.10x at
+    8192x1024) and LOSES at small ones (0.65x at 128x10) — XLA's own
+    rematerialized backward is already good, and per-call dispatch (~0.4 ms
+    on the tunneled runtime) floors everything at MNIST scale. Hence this
+    stays OPT-IN (``SparseCategoricalCrossentropy(fused=True)``): worth it
+    for inference/eval or large-vocabulary heads, not for the reference's
+    tiny-classifier training loop.
     """
     if interpret is None:
         interpret = False
