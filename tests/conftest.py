@@ -35,6 +35,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "multiprocess: spawns loopback multi-worker processes (slower)")
+    config.addinivalue_line(
+        "markers",
+        "realdata: needs real datasets under $TPU_DIST_DATA_DIR "
+        "(populate with scripts/fetch_data.py; skipped otherwise)")
 
 
 @pytest.fixture(scope="session")

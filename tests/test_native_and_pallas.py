@@ -53,6 +53,79 @@ class TestNativeLoader:
         assert not np.array_equal(first, second)  # fresh shuffle per pass
 
 
+class TestNativeLoaderConcurrency:
+    """§5.2 race-detection bar: the loader is the host-side race surface."""
+
+    def test_concurrent_gather_threads_agree_with_serial(self):
+        # The real usage pattern: several pipeline threads (prefetch +
+        # independent Dataset iterators) assembling batches from one shared
+        # dataset concurrently. Results must be identical to serial assembly.
+        import threading
+
+        rng = np.random.default_rng(1)
+        imgs = rng.integers(0, 256, size=(512, 14, 14, 1)).astype(np.uint8)
+        labels = rng.integers(0, 10, 512).astype(np.int64)
+
+        def assemble(seed):
+            idx = native.shuffled_indices(512, seed)[:96]
+            return (native.gather_scale(imgs, idx, 1 / 255.0, n_threads=4),
+                    native.gather_labels(labels, idx))
+
+        serial = [assemble(s) for s in range(8)]
+        results = [None] * 8
+        errors = []
+
+        def worker(s):
+            try:
+                for _ in range(4):  # re-run to widen the race window
+                    results[s] = assemble(s)
+            except Exception as e:  # surfaced below; thread must not die mute
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(s,))
+                   for s in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        for (xa, ya), (xb, yb) in zip(serial, results):
+            assert np.array_equal(xa, xb)
+            assert np.array_equal(ya, yb)
+
+    def test_tsan_stress_clean(self, tmp_path):
+        # Build loader.cpp + tsan_stress.cpp under -fsanitize=thread and run
+        # the multithreaded stress driver; any data race fails the test
+        # (VERDICT r1 item 9 / SURVEY.md §5.2). Skips where the toolchain has
+        # no TSAN runtime.
+        import pathlib
+        import subprocess
+
+        src_dir = pathlib.Path(native.__file__).parent / "_native"
+        binary = tmp_path / "tsan_stress"
+        build = subprocess.run(
+            ["g++", "-fsanitize=thread", "-O1", "-g", "-pthread",
+             str(src_dir / "loader.cpp"), str(src_dir / "tsan_stress.cpp"),
+             "-o", str(binary)],
+            capture_output=True, text=True, timeout=180)
+        if build.returncode != 0:
+            pytest.skip(f"no usable TSAN toolchain: {build.stderr[:200]}")
+        import os
+
+        run = subprocess.run(
+            [str(binary)], capture_output=True, text=True, timeout=300,
+            env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1"})
+        out = run.stdout + run.stderr
+        if "FATAL: ThreadSanitizer" in out and "data race" not in out:
+            # TSAN runtime refused to start (e.g. incompatible ASLR config:
+            # vm.mmap_rnd_bits too high for this libtsan) — environment
+            # limitation, not a race.
+            pytest.skip(f"TSAN runtime cannot start here: {out[:200]}")
+        assert run.returncode == 0, out
+        assert "WARNING: ThreadSanitizer" not in out, out
+        assert "tsan_stress ok" in run.stdout
+
+
 class TestPallasCrossEntropy:
     def _data(self, b=128, c=10):
         rng = np.random.default_rng(0)

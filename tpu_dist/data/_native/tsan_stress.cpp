@@ -1,0 +1,77 @@
+// ThreadSanitizer stress driver for the native loader core (SURVEY.md §5.2).
+//
+// The reference stack documents its collective-launch races and mitigations
+// (SURVEY.md §5.2: cross_device_ops.py:1075-1088); on the TPU-native stack
+// those vanish under XLA and the remaining race surface is host-side — this
+// loader. This driver reproduces the real concurrency pattern around
+// loader.cpp: several pipeline threads (prefetch + per-Dataset iterators)
+// each assembling their own batches with the multithreaded fused gather,
+// all reading one shared dataset. Built and run under -fsanitize=thread by
+// `make tsan` / tests/test_native_and_pallas.py::TestNativeLoaderTsan.
+//
+// Exit code 0 and no "WARNING: ThreadSanitizer" output = clean.
+//
+// Build: g++ -fsanitize=thread -O1 -g -pthread loader.cpp tsan_stress.cpp \
+//            -o tsan_stress
+
+#include <cstdint>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void tpu_dist_gather_scale_u8_f32(const uint8_t* in, const int64_t* idx,
+                                  int64_t n_out, int64_t row_elems,
+                                  float scale, float* out, int n_threads);
+void tpu_dist_gather_i64(const int64_t* in, const int64_t* idx, int64_t n_out,
+                         int64_t row_elems, int64_t* out);
+void tpu_dist_shuffled_indices(int64_t n, uint64_t seed, int64_t* out);
+}
+
+namespace {
+
+constexpr int64_t kRows = 1024;
+constexpr int64_t kRowElems = 28 * 28;  // MNIST-shaped
+constexpr int64_t kBatch = 128;
+constexpr int kPipelineThreads = 4;     // concurrent iterators/prefetchers
+constexpr int kRounds = 16;             // batches per pipeline thread
+constexpr int kInnerThreads = 4;        // n_threads inside each gather call
+
+void pipeline_thread(const uint8_t* images, const int64_t* labels, int id,
+                     float* checksum_out) {
+  std::vector<int64_t> perm(kRows);
+  std::vector<float> batch(kBatch * kRowElems);
+  std::vector<int64_t> lab(kBatch);
+  float checksum = 0.f;
+  for (int r = 0; r < kRounds; ++r) {
+    tpu_dist_shuffled_indices(kRows, 0x9E37 * id + r, perm.data());
+    tpu_dist_gather_scale_u8_f32(images, perm.data(), kBatch, kRowElems,
+                                 1.0f / 255.0f, batch.data(), kInnerThreads);
+    tpu_dist_gather_i64(labels, perm.data(), kBatch, 1, lab.data());
+    checksum += batch[(r * 31) % (kBatch * kRowElems)] +
+                static_cast<float>(lab[r % kBatch]);
+  }
+  *checksum_out = checksum;  // keep the work observable
+}
+
+}  // namespace
+
+int main() {
+  std::vector<uint8_t> images(kRows * kRowElems);
+  std::vector<int64_t> labels(kRows);
+  for (int64_t i = 0; i < kRows * kRowElems; ++i)
+    images[i] = static_cast<uint8_t>((i * 131) & 0xFF);
+  for (int64_t i = 0; i < kRows; ++i) labels[i] = i % 10;
+
+  std::vector<float> checksums(kPipelineThreads);
+  std::vector<std::thread> threads;
+  for (int t = 0; t < kPipelineThreads; ++t)
+    threads.emplace_back(pipeline_thread, images.data(), labels.data(), t,
+                         &checksums[t]);
+  for (auto& t : threads) t.join();
+
+  float total = 0.f;
+  for (float c : checksums) total += c;
+  std::printf("tsan_stress ok checksum=%f\n", total);
+  return 0;
+}
