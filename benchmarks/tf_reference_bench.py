@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Measure the REFERENCE stack itself (TF MultiWorkerMirroredStrategy) on this
+host, so bench.py's vs_baseline compares like against like: same machine, same
+synthetic dataset, same model/optimizer/batch, same 2-worker loopback topology
+the reference demonstrates (reference: tf_dist_example.py:1-59, README.md:
+156-162). SURVEY.md §3.5's ~62 ms/step was measured on survey hardware; this
+script replaces that constant with a number from the hardware the comparison
+actually runs on.
+
+Runs the reference program (TF_CONFIG 2-worker loopback, CollectiveCommunication
+AUTO, the exact 2-conv CNN, SGD lr=0.001, global batch 128) on the SAME
+deterministic synthetic MNIST tpu_dist benches use, times steady-state steps on
+the chief, and prints one JSON line. Requires tensorflow + tf_keras (the
+reference's own era: stock Keras 3 crashes on MWMS PerReplica input,
+SURVEY.md §3.5); exits rc=3 if they're missing so callers can skip gracefully.
+
+Usage:
+    python benchmarks/tf_reference_bench.py            # orchestrates 2 workers
+    python benchmarks/tf_reference_bench.py --warmup-steps 20 --timed-steps 40
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_main(args) -> int:
+    """One TF worker process (the reference program, instrumented)."""
+    os.environ["TF_USE_LEGACY_KERAS"] = "1"  # reference-era Keras 2 trainer
+    os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "2")
+    try:
+        import tensorflow as tf
+    except ImportError:
+        return 3
+
+    sys.path.insert(0, str(REPO))
+    from tpu_dist.data.sources import load_arrays  # same data both stacks
+
+    x, y = load_arrays("mnist", "train")
+    x = x.astype("float32") / 255.0
+    y = y.astype("int64")
+
+    strategy = tf.distribute.experimental.MultiWorkerMirroredStrategy(
+        tf.distribute.experimental.CollectiveCommunication.AUTO)
+
+    ds = (tf.data.Dataset.from_tensor_slices((x, y))
+          .cache().shuffle(10000).batch(args.batch, drop_remainder=True)
+          .repeat())
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = (
+        tf.data.experimental.AutoShardPolicy.OFF)
+    ds = ds.with_options(options)
+
+    with strategy.scope():
+        model = tf.keras.Sequential([
+            tf.keras.layers.Conv2D(32, 3, activation="relu",
+                                   input_shape=(28, 28, 1)),
+            tf.keras.layers.MaxPooling2D(),
+            tf.keras.layers.Conv2D(64, 3, activation="relu"),
+            tf.keras.layers.MaxPooling2D(),
+            tf.keras.layers.Flatten(),
+            tf.keras.layers.Dense(128, activation="relu"),
+            tf.keras.layers.Dense(10),
+        ])
+        model.compile(
+            loss=tf.keras.losses.SparseCategoricalCrossentropy(
+                from_logits=True),
+            optimizer=tf.keras.optimizers.SGD(learning_rate=0.001),
+            metrics=[tf.keras.metrics.SparseCategoricalAccuracy()])
+
+    # Warmup epoch covers tracing/compile + collective bring-up; the timed
+    # epoch is steady state (matches how SURVEY.md §3.5 read step time).
+    model.fit(ds, epochs=1, steps_per_epoch=args.warmup_steps, verbose=0)
+    t0 = time.perf_counter()
+    model.fit(ds, epochs=1, steps_per_epoch=args.timed_steps, verbose=0)
+    elapsed = time.perf_counter() - t0
+
+    task = json.loads(os.environ["TF_CONFIG"])["task"]
+    if task["index"] == 0:
+        n_workers = len(json.loads(os.environ["TF_CONFIG"])
+                        ["cluster"]["worker"])
+        step_ms = elapsed / args.timed_steps * 1e3
+        img_per_sec = args.batch * args.timed_steps / elapsed
+        print(json.dumps({
+            "mode": "tf_reference_mwms_loopback",
+            "tf_version": tf.__version__,
+            "workers": n_workers,
+            "global_batch_per_worker_stream": args.batch,
+            "timed_steps": args.timed_steps,
+            "step_ms": round(step_ms, 3),
+            "images_per_sec": round(img_per_sec, 1),
+            # 1 CPU device per worker => per-core == per-worker stream rate.
+            "images_per_sec_per_core": round(img_per_sec / 1.0, 1),
+        }))
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=128)
+    parser.add_argument("--warmup-steps", type=int, default=20)
+    parser.add_argument("--timed-steps", type=int, default=40)
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--worker-index", type=int, default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--timeout", type=float, default=1200)
+    args = parser.parse_args(argv)
+
+    if args.worker_index is not None:
+        return _worker_main(args)
+
+    # Orchestrator: spawn one process per worker with loopback TF_CONFIG.
+    try:
+        import tensorflow  # noqa: F401  (fail fast before spawning)
+        import tf_keras  # noqa: F401
+    except ImportError as e:
+        print(f"tensorflow/tf_keras unavailable: {e}", file=sys.stderr)
+        return 3
+
+    ports = [_free_port() for _ in range(args.workers)]
+    cluster = {"worker": [f"127.0.0.1:{p}" for p in ports]}
+    procs = []
+    for i in range(args.workers):
+        env = dict(os.environ)
+        env["TF_CONFIG"] = json.dumps(
+            {"cluster": cluster, "task": {"type": "worker", "index": i}})
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--worker-index", str(i), "--batch", str(args.batch),
+             "--warmup-steps", str(args.warmup_steps),
+             "--timed-steps", str(args.timed_steps)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    outs = []
+    deadline = time.monotonic() + args.timeout
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=max(1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            print("tf reference bench timed out", file=sys.stderr)
+            return 4
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        if rc != 0:
+            print(f"worker failed rc={rc}:\n{err[-1500:]}", file=sys.stderr)
+            return rc
+    for rc, out, err in outs:
+        for line in out.splitlines():
+            if line.startswith("{"):
+                print(line)
+                return 0
+    print("no JSON from chief", file=sys.stderr)
+    return 5
+
+
+if __name__ == "__main__":
+    sys.exit(main())

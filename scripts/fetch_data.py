@@ -39,8 +39,10 @@ import numpy as np
 # Canonical mirrors. MNIST's original host (yann.lecun.com) throttles and
 # breaks; the ossci mirror serves the identical files (same sha256).
 _MNIST_BASE = "https://ossci-datasets.s3.amazonaws.com/mnist/"
-_FASHION_BASE = ("https://storage.googleapis.com/tensorflow/tf-keras-datasets/"
-                 "fashion-mnist/")
+# No subdirectory: the tf-keras-datasets bucket serves Fashion-MNIST's idx
+# files at the bucket root (keras:src/datasets/fashion_mnist.py:68-78 —
+# "fashion-mnist" there is only the LOCAL cache_subdir).
+_FASHION_BASE = "https://storage.googleapis.com/tensorflow/tf-keras-datasets/"
 _CIFAR_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
 
 _IDX_FILES = (

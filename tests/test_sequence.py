@@ -1,0 +1,108 @@
+"""Ring-attention / sequence-parallel tests (tpu_dist.parallel.sequence).
+
+Exactness bar: ring attention over a sequence-sharded mesh must equal dense
+softmax attention on the gathered arrays — values AND gradients — for both
+bidirectional and causal masking, including the combined seq x data mesh.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.parallel import make_mesh
+from tpu_dist.parallel.sequence import ring_attention, sequence_sharding
+
+
+def _dense_attention(q, k, v, *, causal=False, scale=None):
+    scale = scale or 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        ln = q.shape[2]
+        mask = np.tril(np.ones((ln, ln), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _qkv(b=2, h=3, ln=32, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(b, h, ln, d)).astype(np.float32))
+    return mk(), mk(), mk()
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, eight_devices, causal):
+        mesh = make_mesh({"seq": 8})
+        q, k, v = _qkv()
+        out = ring_attention(q, k, v, mesh=mesh, axis_name="seq",
+                             causal=causal)
+        ref = _dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_dense(self, eight_devices, causal):
+        mesh = make_mesh({"seq": 8})
+        q, k, v = _qkv(ln=16, d=4)
+
+        def loss_ring(args):
+            return ring_attention(*args, mesh=mesh, axis_name="seq",
+                                  causal=causal).sum()
+
+        def loss_dense(args):
+            return _dense_attention(*args, causal=causal).sum()
+
+        g_ring = jax.grad(loss_ring)((q, k, v))
+        g_dense = jax.grad(loss_dense)((q, k, v))
+        for a, b in zip(g_ring, g_dense):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5, rtol=3e-5)
+
+    def test_combined_data_and_seq_axes(self, eight_devices):
+        # 2-way data parallel x 4-way sequence parallel on the same mesh.
+        mesh = make_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=4, ln=16)
+        out = ring_attention(q, k, v, mesh=mesh, axis_name="seq",
+                             causal=True, batch_axis="data")
+        ref = _dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_jit_with_sharded_inputs_stays_sharded(self, eight_devices):
+        # The long-context contract: inputs arrive sequence-sharded, the
+        # compiled program keeps them that way (no silent full gather onto
+        # one device).
+        mesh = make_mesh({"seq": 8})
+        q, k, v = _qkv(ln=64)
+        sh = sequence_sharding(mesh)
+        qs, ks, vs = (jax.device_put(x, sh) for x in (q, k, v))
+        fn = jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, mesh=mesh, axis_name="seq", causal=True))
+        out = fn(qs, ks, vs)
+        assert out.sharding.is_equivalent_to(sh, out.ndim)
+        # Each device holds exactly its L/8 slice.
+        shard_shapes = {s.data.shape for s in out.addressable_shards}
+        assert shard_shapes == {(2, 3, 8, 8)}
+        ref = _dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_rejects_indivisible_length(self, eight_devices):
+        mesh = make_mesh({"seq": 8})
+        q, k, v = _qkv(ln=12)
+        with pytest.raises(ValueError, match="not divisible"):
+            ring_attention(q, k, v, mesh=mesh, axis_name="seq")
+
+    def test_custom_scale(self, eight_devices):
+        mesh = make_mesh({"seq": 8})
+        q, k, v = _qkv()
+        out = ring_attention(q, k, v, mesh=mesh, axis_name="seq", scale=0.25)
+        ref = _dense_attention(q, k, v, scale=0.25)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)

@@ -7,7 +7,8 @@
 // loader.cpp: several pipeline threads (prefetch + per-Dataset iterators)
 // each assembling their own batches with the multithreaded fused gather,
 // all reading one shared dataset. Built and run under -fsanitize=thread by
-// `make tsan` / tests/test_native_and_pallas.py::TestNativeLoaderTsan.
+// `make tsan` / tests/test_native_and_pallas.py::
+// TestNativeLoaderConcurrency::test_tsan_stress_clean.
 //
 // Exit code 0 and no "WARNING: ThreadSanitizer" output = clean.
 //

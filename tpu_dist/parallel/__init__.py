@@ -18,6 +18,11 @@ from tpu_dist.parallel.collectives import (
     host_all_reduce_sum,
     set_collective_logging,
 )
+from tpu_dist.parallel.sequence import (
+    SEQ_AXIS,
+    ring_attention,
+    sequence_sharding,
+)
 from tpu_dist.parallel.strategy import (
     DefaultStrategy,
     MirroredStrategy,
@@ -43,6 +48,9 @@ __all__ = [
     "broadcast_from_chief",
     "host_all_reduce_sum",
     "set_collective_logging",
+    "SEQ_AXIS",
+    "ring_attention",
+    "sequence_sharding",
     "DefaultStrategy",
     "MirroredStrategy",
     "MultiWorkerMirroredStrategy",
