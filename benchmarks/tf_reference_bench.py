@@ -83,12 +83,20 @@ def _worker_main(args) -> int:
             optimizer=tf.keras.optimizers.SGD(learning_rate=0.001),
             metrics=[tf.keras.metrics.SparseCategoricalAccuracy()])
 
-    # Warmup epoch covers tracing/compile + collective bring-up; the timed
-    # epoch is steady state (matches how SURVEY.md §3.5 read step time).
+    # Warmup epoch covers tracing/compile + collective bring-up; then 3
+    # timed windows with best + median reported — the same
+    # noisy-shared-host policy tpu_dist's own step bench uses
+    # (bench.py run_step_bench), so both sides of the vs_baseline ratio are
+    # measured identically. (SURVEY.md §3.5 read a single steady window;
+    # this host's CPU is noisy enough for 3x run-to-run swings.)
     model.fit(ds, epochs=1, steps_per_epoch=args.warmup_steps, verbose=0)
-    t0 = time.perf_counter()
-    model.fit(ds, epochs=1, steps_per_epoch=args.timed_steps, verbose=0)
-    elapsed = time.perf_counter() - t0
+    windows = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        model.fit(ds, epochs=1, steps_per_epoch=args.timed_steps, verbose=0)
+        windows.append(time.perf_counter() - t0)
+    elapsed = min(windows)
+    median = sorted(windows)[len(windows) // 2]
 
     task = json.loads(os.environ["TF_CONFIG"])["task"]
     if task["index"] == 0:
@@ -102,7 +110,9 @@ def _worker_main(args) -> int:
             "workers": n_workers,
             "global_batch_per_worker_stream": args.batch,
             "timed_steps": args.timed_steps,
+            "timing_windows": len(windows),
             "step_ms": round(step_ms, 3),
+            "step_ms_median": round(median / args.timed_steps * 1e3, 3),
             "images_per_sec": round(img_per_sec, 1),
             # 1 CPU device per worker => per-core == per-worker stream rate.
             "images_per_sec_per_core": round(img_per_sec / 1.0, 1),

@@ -86,13 +86,27 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int,
         # At ring step t this device holds the shard originating at
         # source = (my_idx - t) mod P (shards travel source -> source+1).
         src = (my_idx - t) % axis_size
-        scores = jnp.einsum("...qd,...kd->...qk", qf,
-                            k_cur.astype(jnp.float32))
+
+        def consume(mla):
+            m, l, acc = mla
+            scores = jnp.einsum("...qd,...kd->...qk", qf,
+                                k_cur.astype(jnp.float32))
+            if causal:
+                kv_pos = src * lc + jnp.arange(lc)  # [Lc]
+                mask = q_pos[:, None] >= kv_pos[None, :]  # [Lq, Lk]
+                scores = jnp.where(mask, scores, -jnp.inf)
+            return _online_merge(m, l, acc, scores, v_cur)
+
         if causal:
-            kv_pos = src * lc + jnp.arange(lc)  # [Lc]
-            mask = q_pos[:, None] >= kv_pos[None, :]  # [Lq, Lk]
-            scores = jnp.where(mask, scores, -jnp.inf)
-        m, l, acc = _online_merge(m, l, acc, scores, v_cur)
+            # A shard from a strictly-future source is entirely masked:
+            # skip its matmuls instead of computing blocks that contribute
+            # exactly zero — that dead work would approach HALF the
+            # attention FLOPs at large ring sizes. (src == my_idx is the
+            # diagonal block: half-masked, must still be computed.)
+            m, l, acc = jax.lax.cond(src > my_idx, lambda mla: mla, consume,
+                                     (m, l, acc))
+        else:
+            m, l, acc = consume((m, l, acc))
         # Rotate AFTER consuming: shard moves to the next device so that at
         # step t+1 we hold source (my_idx - t - 1). The last rotation is
         # redundant but keeps the scan body uniform; XLA overlaps it with
