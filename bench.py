@@ -405,7 +405,8 @@ def run_cpu_baseline() -> dict:
     below), end-to-end fit loop — compared against the ACTUAL
     TF MultiWorkerMirroredStrategy reference program measured on this same
     host (measure_tf_reference), falling back to the survey's ~62 ms/step
-    (=> ~1032 img/s/core, SURVEY.md §3.5) when TF is unavailable."""
+    (=> ~2065 img/s/core per worker stream, SURVEY.md §3.5) when TF is
+    unavailable."""
     # Global batch 256 = the reference's effective consumption: with
     # autoshard OFF each of its 2 workers draws its OWN batch of 128
     # (SURVEY.md §3.4), so 256 distinct images/step over 2 cores. Our SPMD

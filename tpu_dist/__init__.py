@@ -36,6 +36,7 @@ from tpu_dist.data import AutoShardPolicy, Dataset, Options
 from tpu_dist.models import Model, Sequential, build_and_compile_cnn_model
 from tpu_dist.parallel import (
     CollectiveCommunication,
+    InputContext,
     MirroredStrategy,
     MultiWorkerMirroredStrategy,
     ParameterServerStrategy,
@@ -61,7 +62,7 @@ __all__ = [
     "ClusterConfig", "barrier", "initialize", "is_chief",
     "AutoShardPolicy", "Dataset", "Options",
     "Model", "Sequential", "build_and_compile_cnn_model",
-    "CollectiveCommunication", "MirroredStrategy",
+    "CollectiveCommunication", "InputContext", "MirroredStrategy",
     "MultiWorkerMirroredStrategy", "ParameterServerStrategy", "ReduceOp",
     "Strategy", "get_strategy",
     "Callback", "EarlyStopping", "History", "JSONLogger", "LambdaCallback",

@@ -25,6 +25,7 @@ from tpu_dist.parallel.sequence import (
 )
 from tpu_dist.parallel.strategy import (
     DefaultStrategy,
+    InputContext,
     MirroredStrategy,
     MultiWorkerMirroredStrategy,
     ParameterServerStrategy,
@@ -52,6 +53,7 @@ __all__ = [
     "ring_attention",
     "sequence_sharding",
     "DefaultStrategy",
+    "InputContext",
     "MirroredStrategy",
     "MultiWorkerMirroredStrategy",
     "ParameterServerStrategy",

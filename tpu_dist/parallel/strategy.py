@@ -45,6 +45,32 @@ def _strategy_stack() -> list:
     return _LOCAL.stack
 
 
+class InputContext:
+    """Per-process input-pipeline context handed to ``dataset_fn`` by
+    :meth:`Strategy.distribute_datasets_from_function` — the analog of
+    ``tf.distribute.InputContext`` (SURVEY.md D14): which input pipeline this
+    process is (``input_pipeline_id`` of ``num_input_pipelines``) and how to
+    derive a per-replica batch from a global one."""
+
+    def __init__(self, num_input_pipelines: int, input_pipeline_id: int,
+                 num_replicas_in_sync: int):
+        self.num_input_pipelines = num_input_pipelines
+        self.input_pipeline_id = input_pipeline_id
+        self.num_replicas_in_sync = num_replicas_in_sync
+
+    def get_per_replica_batch_size(self, global_batch_size: int) -> int:
+        if global_batch_size % self.num_replicas_in_sync:
+            raise ValueError(
+                f"global batch {global_batch_size} not divisible by "
+                f"{self.num_replicas_in_sync} replicas")
+        return global_batch_size // self.num_replicas_in_sync
+
+    def __repr__(self) -> str:
+        return (f"InputContext(pipeline {self.input_pipeline_id}/"
+                f"{self.num_input_pipelines}, "
+                f"replicas={self.num_replicas_in_sync})")
+
+
 class _Scope:
     def __init__(self, strategy: "Strategy"):
         self._strategy = strategy
@@ -122,6 +148,113 @@ class Strategy:
         from tpu_dist.data.distribute import DistributedDataset
 
         return DistributedDataset(dataset, self, policy=policy)
+
+    def distribute_datasets_from_function(self, dataset_fn, options=None):
+        """Per-worker dataset construction — the analog of TF's
+        ``strategy.distribute_datasets_from_function`` (SURVEY.md D14):
+        ``dataset_fn(InputContext)`` builds THIS process's stream (the
+        context says which pipeline this is, so the fn can shard sources or
+        derive a per-replica batch itself), and each step's local batch is
+        assembled into the global sharded array. Because the fn already did
+        any cross-worker sharding, no further autoshard rewrite is applied
+        (same contract as TF: the fn's output is taken as-is per worker)."""
+        import jax
+
+        from tpu_dist.data.distribute import DistributedDataset
+        from tpu_dist.data.pipeline import AutoShardPolicy
+
+        ctx = InputContext(
+            num_input_pipelines=jax.process_count(),
+            input_pipeline_id=jax.process_index(),
+            num_replicas_in_sync=self.num_replicas_in_sync)
+        dataset = dataset_fn(ctx)
+        return DistributedDataset(dataset, self, policy=AutoShardPolicy.OFF)
+
+    # TF shipped the same API under an experimental_ prefix first; accept both.
+    experimental_distribute_datasets_from_function = \
+        distribute_datasets_from_function
+
+    def run(self, fn, args=(), kwargs=None):
+        """Run ``fn`` once per replica — TF's ``strategy.run``, the custom-
+        training-loop surface (the reference's fit path calls it inside Keras,
+        keras:src/backend/tensorflow/trainer.py:134; SURVEY.md D15/L4).
+
+        TPU-native semantics: the call IS one compiled program — a cached
+        ``jax.jit`` around a ``shard_map`` over the mesh (do NOT wrap it in
+        another ``jax.jit``; under an outer trace the arguments' shardings
+        are invisible, so ``run`` raises instead of silently mis-sharding).
+        Arguments that are global arrays sharded over the data axis
+        (``distribute_batch`` / distributed-dataset output) arrive in ``fn``
+        as this replica's local shard; everything else is replicated. Inside
+        ``fn``, cross-replica collectives are available as
+        ``jax.lax.psum/pmean(..., strategy.data_axis)``. Returns per-replica
+        outputs stacked on a leading replica axis — feed to
+        :meth:`reduce` (``strategy.reduce("mean", result)``), which is
+        exactly TF's run-then-reduce idiom.
+
+        The compiled program is cached per ``(fn, argument structure,
+        sharding layout)``; repeated calls in a training loop hit the cache,
+        so write loops exactly like TF's ``strategy.run(step, (batch,))``.
+
+        Gradient semantics (SPMD, differs from TF's per-replica tapes in a
+        convenient way): differentiating w.r.t. a REPLICATED argument (model
+        params) implicitly psums the cotangents across replicas — scale the
+        per-replica loss by ``1/num_replicas_in_sync`` (TF's own custom-loop
+        guidance) and the returned gradient is already the fully all-reduced
+        global gradient on every replica, no explicit collective needed.
+        """
+        import jax
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        kwargs = kwargs or {}
+        flat, treedef = jax.tree.flatten((args, kwargs))
+        if any(isinstance(x, jax.core.Tracer) for x in flat):
+            raise ValueError(
+                "strategy.run was called under a jax transformation (jit/"
+                "grad/vmap trace). run() already compiles its own SPMD "
+                "program and must see concrete arrays to read their "
+                "shardings — call it outside jit, or use shard_map "
+                "directly for custom composition.")
+
+        def spec_for(x):
+            sh = getattr(x, "sharding", None)
+            if (isinstance(sh, NamedSharding) and sh.mesh == self._mesh
+                    and any(ax == self.data_axis
+                            for ax in jax.tree.leaves(tuple(sh.spec)))):
+                return P(*sh.spec)
+            return P()
+
+        in_specs = tuple(spec_for(x) for x in flat)
+        key = (fn, treedef, in_specs)
+        cache = getattr(self, "_run_cache", None)
+        if cache is None:
+            cache = self._run_cache = {}
+        compiled = cache.get(key)
+        if compiled is None:
+            compiled = cache[key] = self._build_run_program(
+                fn, treedef, in_specs)
+        return compiled(*flat)
+
+    def _build_run_program(self, fn, treedef, in_specs):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
+
+        def body(*leaves):
+            a, k = jax.tree.unflatten(treedef, leaves)
+            out = fn(*a, **k)
+            # Leading replica axis: each replica contributes [1, ...]; the
+            # out_spec concatenates them to [num_replicas, ...] — the
+            # PerReplica-stack convention reduce() consumes.
+            return jax.tree.map(lambda t: jnp.asarray(t)[None], out)
+
+        return jax.jit(shard_map(body, mesh=self._mesh, in_specs=in_specs,
+                                 out_specs=P(self.data_axis)))
 
     def reduce(self, op: ReduceOp | str, value):
         """Host-side reduction of a per-replica value to a single result."""
