@@ -136,6 +136,35 @@ class TestStrategyRun:
         strategy.run(fn, args=(strategy.distribute_batch(x + 1),))
         assert len(strategy._run_cache) == 1  # same fn/structure/sharding
 
+    def test_inline_lambda_hits_cache(self, eight_devices):
+        # The natural TF-port pattern: a fresh lambda every loop iteration
+        # must not recompile (keyed on code + closure values, not identity).
+        strategy = td.MirroredStrategy()
+        x = np.arange(16, dtype=np.float32)
+
+        def step(b):
+            return b.sum()
+
+        for i in range(3):
+            strategy.run(lambda b: step(b),
+                         args=(strategy.distribute_batch(x + i),))
+        assert len(strategy._run_cache) == 1
+
+    def test_reduce_pytree_outputs(self, eight_devices):
+        # The documented run-then-reduce idiom must work on dict outputs.
+        strategy = td.MirroredStrategy()
+        x = np.arange(16, dtype=np.float32)
+        xb = strategy.distribute_batch(x)
+
+        def fn(batch):
+            return {"sum": batch.sum(), "pair": (batch.mean(), batch.max())}
+
+        out = strategy.run(fn, args=(xb,))
+        red = strategy.reduce("sum", out)
+        np.testing.assert_allclose(float(red["sum"]), x.sum())
+        red_m = strategy.reduce("mean", out)
+        np.testing.assert_allclose(float(red_m["pair"][0]), x.mean())
+
 
 class TestDistributeDatasetsFromFunction:
     def test_input_context_fields(self, eight_devices):
@@ -144,11 +173,12 @@ class TestDistributeDatasetsFromFunction:
 
         def dataset_fn(ctx):
             seen["ctx"] = ctx
-            batch = ctx.get_per_replica_batch_size(32) * \
-                ctx.num_replicas_in_sync
+            # TF's contract: batch to the PER-REPLICA size; the wrapper
+            # draws one element per local replica and stacks them.
             x = np.arange(64, dtype=np.float32).reshape(64, 1)
             return td.data.Dataset.from_tensor_slices(
-                (x, np.zeros(64, np.int64))).batch(batch)
+                (x, np.zeros(64, np.int64))).batch(
+                ctx.get_per_replica_batch_size(32))
 
         dist = strategy.distribute_datasets_from_function(dataset_fn)
         ctx = seen["ctx"]
@@ -158,8 +188,12 @@ class TestDistributeDatasetsFromFunction:
         with pytest.raises(ValueError, match="not divisible"):
             ctx.get_per_replica_batch_size(33)
         xb, yb = next(iter(dist))
-        assert xb.shape == (32, 1)  # global batch, sharded over the mesh
+        # Effective global batch = per-replica 4 x 8 replicas, and each
+        # replica's shard is exactly one dataset element (TF consumption).
+        assert xb.shape == (32, 1)
         assert len(xb.sharding.device_set) == 8
+        np.testing.assert_array_equal(
+            np.asarray(xb).ravel(), np.arange(32, dtype=np.float32))
 
     def test_experimental_alias(self, eight_devices):
         strategy = td.MirroredStrategy()
@@ -175,7 +209,8 @@ class TestDistributeDatasetsFromFunction:
             x = np.zeros((256, 12, 12, 1), np.float32)
             x[np.arange(256), :, labels] = 1.0
             return td.data.Dataset.from_tensor_slices(
-                (x, labels.astype(np.int64))).batch(32)
+                (x, labels.astype(np.int64))).batch(
+                ctx.get_per_replica_batch_size(32)).repeat()
 
         from tpu_dist.models import Dense, Flatten, Sequential
         from tpu_dist.ops import (Adam, SparseCategoricalAccuracy,

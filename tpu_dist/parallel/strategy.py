@@ -152,22 +152,59 @@ class Strategy:
     def distribute_datasets_from_function(self, dataset_fn, options=None):
         """Per-worker dataset construction — the analog of TF's
         ``strategy.distribute_datasets_from_function`` (SURVEY.md D14):
-        ``dataset_fn(InputContext)`` builds THIS process's stream (the
-        context says which pipeline this is, so the fn can shard sources or
-        derive a per-replica batch itself), and each step's local batch is
-        assembled into the global sharded array. Because the fn already did
-        any cross-worker sharding, no further autoshard rewrite is applied
-        (same contract as TF: the fn's output is taken as-is per worker)."""
+        ``dataset_fn(InputContext)`` builds THIS process's stream, batched to
+        the PER-REPLICA size (TF's contract — use
+        ``ctx.get_per_replica_batch_size(global)``). Per training step, one
+        element is drawn for each of this process's replicas and the
+        elements are stacked into the process's contribution to the global
+        sharded batch, so the effective global batch is
+        ``per_replica_batch x num_replicas_in_sync`` — identical consumption
+        to TF's wrapper. Because the fn already did any cross-worker
+        sharding (it knows its ``input_pipeline_id``), no autoshard rewrite
+        is applied."""
         import jax
 
         from tpu_dist.data.distribute import DistributedDataset
-        from tpu_dist.data.pipeline import AutoShardPolicy
+        from tpu_dist.data.pipeline import AutoShardPolicy, Dataset
 
         ctx = InputContext(
             num_input_pipelines=jax.process_count(),
             input_pipeline_id=jax.process_index(),
             num_replicas_in_sync=self.num_replicas_in_sync)
         dataset = dataset_fn(ctx)
+        local_replicas = self.num_replicas_in_sync // jax.process_count()
+
+        if local_replicas > 1:
+            import numpy as np
+
+            def _concat(elements):
+                first = elements[0]
+                if isinstance(first, tuple):
+                    return tuple(_concat([e[i] for e in elements])
+                                 for i in range(len(first)))
+                if isinstance(first, dict):
+                    return {k: _concat([e[k] for e in elements])
+                            for k in first}
+                return np.concatenate([np.asarray(e) for e in elements])
+
+            inner = dataset  # capture BEFORE rebinding the name below
+
+            def rebatch_factory():
+                it = iter(inner)
+                while True:
+                    group = []
+                    try:
+                        for _ in range(local_replicas):
+                            group.append(next(it))
+                    except StopIteration:
+                        return
+                    yield _concat(group)
+
+            card = dataset.cardinality()
+            dataset = Dataset(
+                rebatch_factory,
+                cardinality=(card // local_replicas if card and card > 0
+                             else card))
         return DistributedDataset(dataset, self, policy=AutoShardPolicy.OFF)
 
     # TF shipped the same API under an experimental_ prefix first; accept both.
@@ -226,7 +263,7 @@ class Strategy:
             return P()
 
         in_specs = tuple(spec_for(x) for x in flat)
-        key = (fn, treedef, in_specs)
+        key = (self._run_fn_key(fn), treedef, in_specs)
         cache = getattr(self, "_run_cache", None)
         if cache is None:
             cache = self._run_cache = {}
@@ -235,6 +272,26 @@ class Strategy:
             compiled = cache[key] = self._build_run_program(
                 fn, treedef, in_specs)
         return compiled(*flat)
+
+    @staticmethod
+    def _run_fn_key(fn):
+        """Cache key for a step function that tolerates the natural TF-port
+        pattern of an inline lambda recreated every call: key on the code
+        object plus the closure VALUES (when hashable), so
+        ``strategy.run(lambda b: step(b), ...)`` in a loop hits the cache
+        instead of recompiling per step. Unhashable closure contents fall
+        back to object identity (each distinct closure compiles once)."""
+        code = getattr(fn, "__code__", None)
+        if code is None:  # callable object — identity
+            return fn
+        cells = getattr(fn, "__closure__", None) or ()
+        try:
+            key = (code, tuple(c.cell_contents for c in cells),
+                   getattr(fn, "__defaults__", None))
+            hash(key)  # unhashable closure contents -> identity fallback
+            return key
+        except (TypeError, ValueError):  # unhashable / empty cell
+            return fn
 
     def _build_run_program(self, fn, treedef, in_specs):
         import jax
@@ -257,16 +314,24 @@ class Strategy:
                                  out_specs=P(self.data_axis)))
 
     def reduce(self, op: ReduceOp | str, value):
-        """Host-side reduction of a per-replica value to a single result."""
+        """Host-side reduction of per-replica values to single results,
+        applied leaf-wise over pytrees (dict/tuple outputs of :meth:`run`
+        reduce per leaf, like TF's ``strategy.reduce``)."""
+        import jax
         import jax.numpy as jnp
 
         op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
-        v = jnp.asarray(value)
-        if op is ReduceOp.SUM:
-            return v.sum(axis=0) if v.ndim else v
-        if op is ReduceOp.MEAN:
-            return v.mean(axis=0) if v.ndim else v
-        raise ValueError(f"host-side reduce supports SUM/MEAN, got {op}")
+        if op not in (ReduceOp.SUM, ReduceOp.MEAN):
+            raise ValueError(
+                f"host-side reduce supports SUM/MEAN, got {op}")
+
+        def red(leaf):
+            v = jnp.asarray(leaf)
+            if not v.ndim:
+                return v
+            return v.sum(axis=0) if op is ReduceOp.SUM else v.mean(axis=0)
+
+        return jax.tree.map(red, value)
 
     def __repr__(self) -> str:
         return (f"{type(self).__name__}(replicas={self.num_replicas_in_sync}, "

@@ -528,10 +528,18 @@ class Trainer:
                     # Keras steps_per_execution semantics: batch hooks fire
                     # once per execution, logs carry the execution's loss.
                     cbs.on_batch_end(step_i - 1, {"loss": loss_val})
-            logs = {"loss": float(loss_acc[0]) / max(float(loss_acc[1]), 1.0),
+            # ONE host sync for every end-of-epoch scalar: each individual
+            # float() is a full round-trip (~100 ms through a tunneled
+            # runtime — measured to dominate short epochs), so queue the
+            # metric-result ops async and fetch everything together.
+            metric_vals = [metric.result(mstate) for metric, mstate
+                           in zip(self.model.metrics, v["metrics"])]
+            (l_sum, l_cnt), metric_vals = jax.device_get(
+                (loss_acc, metric_vals))
+            logs = {"loss": float(l_sum) / max(float(l_cnt), 1.0),
                     "epoch_time": time.perf_counter() - t_epoch}
-            for metric, mstate in zip(self.model.metrics, v["metrics"]):
-                logs[metric.name] = float(metric.result(mstate))
+            for metric, mval in zip(self.model.metrics, metric_vals):
+                logs[metric.name] = float(mval)
             if val_dist is not None:
                 # Keras validation semantics: full validation pass at each
                 # epoch end, reported as val_-prefixed logs (feeds
@@ -571,9 +579,13 @@ class Trainer:
             count += 1
         if count == 0:
             raise RuntimeError("evaluate: dataset yielded no batches")
-        logs = {"loss": float(loss_acc[0]) / max(float(loss_acc[1]), 1.0)}
-        for metric, mstate in zip(self.model.metrics, metric_states):
-            logs[metric.name] = float(metric.result(mstate))
+        # Same one-sync pattern as the epoch end: fetch all scalars together.
+        metric_vals = [metric.result(mstate) for metric, mstate
+                       in zip(self.model.metrics, metric_states)]
+        (l_sum, l_cnt), metric_vals = jax.device_get((loss_acc, metric_vals))
+        logs = {"loss": float(l_sum) / max(float(l_cnt), 1.0)}
+        for metric, mval in zip(self.model.metrics, metric_vals):
+            logs[metric.name] = float(mval)
         return logs
 
     def predict(self, x):
