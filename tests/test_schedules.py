@@ -1,0 +1,134 @@
+"""Learning-rate schedule tests: closed-form values, in-program evaluation
+inside the jitted train step (zero recompiles), checkpoint-compatible
+optimizer state."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_dist.ops import (SGD, Adam, CosineDecay, ExponentialDecay,
+                          PiecewiseConstantDecay, WarmupCosine)
+
+
+def _lr(schedule, step):
+    return float(schedule(jnp.asarray(step)))
+
+
+class TestScheduleValues:
+    def test_exponential(self):
+        s = ExponentialDecay(0.1, decay_steps=10, decay_rate=0.5)
+        assert _lr(s, 0) == pytest.approx(0.1)
+        assert _lr(s, 10) == pytest.approx(0.05)
+        assert _lr(s, 5) == pytest.approx(0.1 * 0.5 ** 0.5)
+
+    def test_exponential_staircase(self):
+        s = ExponentialDecay(0.1, 10, 0.5, staircase=True)
+        assert _lr(s, 9) == pytest.approx(0.1)
+        assert _lr(s, 10) == pytest.approx(0.05)
+        assert _lr(s, 19) == pytest.approx(0.05)
+
+    def test_cosine(self):
+        s = CosineDecay(1.0, decay_steps=100, alpha=0.1)
+        assert _lr(s, 0) == pytest.approx(1.0)
+        assert _lr(s, 100) == pytest.approx(0.1)
+        assert _lr(s, 1000) == pytest.approx(0.1)  # constant past the end
+        mid = 0.5 * (1 + math.cos(math.pi * 0.5))
+        assert _lr(s, 50) == pytest.approx(0.9 * mid + 0.1)
+
+    def test_piecewise(self):
+        s = PiecewiseConstantDecay([5, 10], [1.0, 0.5, 0.1])
+        for step, want in [(0, 1.0), (5, 1.0), (6, 0.5), (10, 0.5),
+                           (11, 0.1), (99, 0.1)]:
+            assert _lr(s, step) == pytest.approx(want), step
+        with pytest.raises(ValueError, match="len"):
+            PiecewiseConstantDecay([5], [1.0])
+
+    def test_warmup_cosine(self):
+        s = WarmupCosine(1.0, warmup_steps=10, decay_steps=90, alpha=0.0)
+        assert _lr(s, 0) == pytest.approx(0.0)
+        assert _lr(s, 5) == pytest.approx(0.5)
+        assert _lr(s, 10) == pytest.approx(1.0)
+        assert _lr(s, 100) == pytest.approx(0.0, abs=1e-6)
+
+
+class TestScheduledOptimizers:
+    def test_sgd_schedule_matches_manual(self):
+        sched = PiecewiseConstantDecay([1], [0.5, 0.25])
+        opt = SGD(learning_rate=sched)
+        params = {"w": jnp.asarray(1.0)}
+        grads = {"w": jnp.asarray(1.0)}
+        state = opt.init(params)
+        assert int(state.step) == 0
+        # step 0: lr = schedule(0) = 0.5 ; step 1: 0.5 ; step 2: 0.25
+        params, state = opt.update(grads, state, params)
+        assert float(params["w"]) == pytest.approx(0.5)
+        params, state = opt.update(grads, state, params)
+        assert float(params["w"]) == pytest.approx(0.0)
+        params, state = opt.update(grads, state, params)
+        assert float(params["w"]) == pytest.approx(-0.25)
+        assert int(state.step) == 3
+
+    def test_sgd_momentum_with_schedule(self):
+        opt = SGD(learning_rate=ExponentialDecay(0.1, 1, 0.5), momentum=0.9)
+        params = {"w": jnp.asarray(0.0)}
+        grads = {"w": jnp.asarray(1.0)}
+        state = opt.init(params)
+        # lr(0)=0.1: v=-0.1, w=-0.1 ; lr(1)=0.05: v=0.9*-0.1-0.05=-0.14
+        params, state = opt.update(grads, state, params)
+        assert float(params["w"]) == pytest.approx(-0.1)
+        params, state = opt.update(grads, state, params)
+        assert float(params["w"]) == pytest.approx(-0.24)
+
+    def test_constant_lr_state_shapes_unchanged(self):
+        # Legacy checkpoint compatibility: float-lr SGD keeps its old state.
+        assert SGD(0.1).init({"w": jnp.zeros(2)}) == ()
+        vel = SGD(0.1, momentum=0.9).init({"w": jnp.zeros(2)})
+        assert set(vel) == {"w"}
+
+    def test_adam_schedule_steps(self):
+        # lr(0)=0.1 (step <= boundary 0), lr(1+)=0.0
+        opt = Adam(learning_rate=PiecewiseConstantDecay([0], [0.1, 0.0]))
+        params = {"w": jnp.asarray(1.0)}
+        grads = {"w": jnp.asarray(1.0)}
+        state = opt.init(params)
+        params, state = opt.update(grads, state, params)
+        moved = float(params["w"])
+        assert moved < 1.0  # first step at lr 0.1
+        params2, state = opt.update(grads, state, params)
+        params3, state = opt.update(grads, state, params2)
+        # lr is 0 from step 1 on -> params frozen.
+        assert float(params2["w"]) == pytest.approx(moved)
+        assert float(params3["w"]) == pytest.approx(moved)
+
+
+class TestScheduleInFit:
+    def test_fit_with_schedule_single_compile(self, eight_devices):
+        import tpu_dist as td
+        from tpu_dist.models import Dense, Flatten, Sequential
+        from tpu_dist.ops import (SparseCategoricalAccuracy,
+                                  SparseCategoricalCrossentropy)
+
+        rng = np.random.default_rng(0)
+        labels = rng.integers(10, size=256)
+        x = np.zeros((256, 8, 8, 1), np.float32)
+        x[np.arange(256), :, labels % 8] = (
+            1.0 + labels[:, None] * 0.01).repeat(8, axis=1)[..., None]
+        ds = td.data.Dataset.from_tensor_slices(
+            (x, labels.astype(np.int64))).batch(32).repeat()
+
+        strategy = td.MirroredStrategy()
+        with strategy.scope():
+            model = Sequential([Flatten(), Dense(10)], input_shape=(8, 8, 1))
+            model.compile(
+                loss=SparseCategoricalCrossentropy(from_logits=True),
+                optimizer=SGD(learning_rate=WarmupCosine(
+                    0.5, warmup_steps=8, decay_steps=40)),
+                metrics=[SparseCategoricalAccuracy()])
+        hist = model.fit(ds, epochs=3, steps_per_epoch=8, verbose=0)
+        losses = hist.history["loss"]
+        assert losses[-1] < losses[0], losses
+        # The schedule lives in optimizer state: step advanced 24 times.
+        assert int(model.variables["opt"].step) == 24

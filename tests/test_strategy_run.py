@@ -150,6 +150,28 @@ class TestStrategyRun:
                          args=(strategy.distribute_batch(x + i),))
         assert len(strategy._run_cache) == 1
 
+    def test_bound_methods_of_different_instances_do_not_collide(
+            self, eight_devices):
+        # Bound methods share __code__/__closure__ with `self` in neither;
+        # the cache key must include the receiver or instance B silently
+        # gets instance A's compiled program.
+        strategy = td.MirroredStrategy()
+        x = np.arange(16, dtype=np.float32)
+        xb = strategy.distribute_batch(x)
+
+        class Scaler:
+            def __init__(self, s):
+                self.s = s
+
+            def step(self, batch):
+                return batch.sum() * self.s
+
+        a, b = Scaler(1.0), Scaler(10.0)
+        out_a = strategy.reduce("sum", strategy.run(a.step, args=(xb,)))
+        out_b = strategy.reduce("sum", strategy.run(b.step, args=(xb,)))
+        np.testing.assert_allclose(float(out_a), x.sum())
+        np.testing.assert_allclose(float(out_b), 10 * x.sum())
+
     def test_reduce_pytree_outputs(self, eight_devices):
         # The documented run-then-reduce idiom must work on dict outputs.
         strategy = td.MirroredStrategy()

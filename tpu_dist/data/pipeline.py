@@ -71,15 +71,28 @@ def _map_structure(fn, element):
     return fn(element)
 
 
-def _batch_structure(elements: Sequence) -> Any:
-    """Stack a list of identically-structured elements into batched arrays."""
+def _combine_structure(elements: Sequence, combine) -> Any:
+    """Recurse a list of identically-structured elements down to leaves and
+    merge each leaf list with ``combine`` (np.stack to batch, np.concatenate
+    to rebatch)."""
     first = elements[0]
     if isinstance(first, tuple):
-        return tuple(_batch_structure([e[i] for e in elements])
+        return tuple(_combine_structure([e[i] for e in elements], combine)
                      for i in range(len(first)))
     if isinstance(first, dict):
-        return {k: _batch_structure([e[k] for e in elements]) for k in first}
-    return np.stack([np.asarray(e) for e in elements])
+        return {k: _combine_structure([e[k] for e in elements], combine)
+                for k in first}
+    return combine([np.asarray(e) for e in elements])
+
+
+def _batch_structure(elements: Sequence) -> Any:
+    """Stack a list of identically-structured elements into batched arrays."""
+    return _combine_structure(elements, np.stack)
+
+
+def _concat_structure(elements: Sequence) -> Any:
+    """Concatenate already-batched elements along their leading dim."""
+    return _combine_structure(elements, np.concatenate)
 
 
 class Dataset:

@@ -16,6 +16,7 @@ from tpu_dist.models.layers import (
     Residual,
 )
 from tpu_dist.models.model import Model, Sequential
+from tpu_dist.models.serialize import load_model, save_model
 from tpu_dist.models.cnn import build_and_compile_cnn_model, build_cnn_model
 from tpu_dist.models.policy import compute_dtype, policy, set_policy
 from tpu_dist.models.resnet import ResNet18, ResNet50
@@ -36,6 +37,8 @@ __all__ = [
     "Residual",
     "Model",
     "Sequential",
+    "load_model",
+    "save_model",
     "ResNet18",
     "ResNet50",
     "build_and_compile_cnn_model",

@@ -181,6 +181,14 @@ class Model:
         """Live training variables, once fit/evaluate has materialized them."""
         return self._trainer.variables if self._trainer is not None else None
 
+    def save(self, directory):
+        """Full-model save: architecture + weights (+ compile config when
+        serializable) in one directory; reload with
+        ``tpu_dist.models.load_model``. Chief-only writes (§5.4)."""
+        from tpu_dist.models import serialize
+
+        return serialize.save_model(self, directory)
+
     def save_weights(self, directory, step: int = 0):
         """Chief-only checkpoint write (README.md:51 chief duty; §5.4)."""
         from tpu_dist.training import checkpoint

@@ -1,6 +1,6 @@
 """Ops layer: initializers, losses, metrics, optimizers."""
 
-from tpu_dist.ops import initializers, losses, metrics, optimizers
+from tpu_dist.ops import initializers, losses, metrics, optimizers, schedules
 from tpu_dist.ops.losses import (
     CategoricalCrossentropy,
     Loss,
@@ -9,12 +9,20 @@ from tpu_dist.ops.losses import (
 )
 from tpu_dist.ops.metrics import Mean, Metric, SparseCategoricalAccuracy
 from tpu_dist.ops.optimizers import SGD, Adam, Optimizer, OptaxWrapper
+from tpu_dist.ops.schedules import (
+    CosineDecay,
+    ExponentialDecay,
+    LearningRateSchedule,
+    PiecewiseConstantDecay,
+    WarmupCosine,
+)
 
 __all__ = [
     "initializers",
     "losses",
     "metrics",
     "optimizers",
+    "schedules",
     "CategoricalCrossentropy",
     "Loss",
     "MeanSquaredError",
@@ -26,4 +34,9 @@ __all__ = [
     "Adam",
     "Optimizer",
     "OptaxWrapper",
+    "CosineDecay",
+    "ExponentialDecay",
+    "LearningRateSchedule",
+    "PiecewiseConstantDecay",
+    "WarmupCosine",
 ]

@@ -29,40 +29,65 @@ class Optimizer:
         raise NotImplementedError
 
     def __repr__(self):
-        attrs = ", ".join(f"{k}={v}" for k, v in vars(self).items())
+        attrs = ", ".join(f"{k}={v}" for k, v in vars(self).items()
+                          if not k.startswith("_"))
         return f"{type(self).__name__}({attrs})"
+
+
+class SGDState(NamedTuple):
+    """State when the lr is a schedule: step counter + velocity pytree
+    (``()`` velocity when momentum is off). Constant-lr SGD keeps its legacy
+    stateless/velocity-only shapes so existing checkpoints restore."""
+
+    step: jnp.ndarray
+    velocity: Any
 
 
 class SGD(Optimizer):
     """SGD with optional momentum/nesterov — tf.keras SGD analog
-    (tf_dist_example.py:51 uses lr=0.001, no momentum)."""
+    (tf_dist_example.py:51 uses lr=0.001, no momentum). ``learning_rate``
+    accepts a float or a ``tpu_dist.ops.schedules`` schedule (evaluated
+    in-program per step; TF semantics: first update sees schedule(0))."""
 
-    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+    def __init__(self, learning_rate=0.01, momentum: float = 0.0,
                  nesterov: bool = False):
-        self.learning_rate = float(learning_rate)
+        from tpu_dist.ops import schedules
+
+        self.learning_rate, self._scheduled = schedules.resolve(learning_rate)
         self.momentum = float(momentum)
         self.nesterov = bool(nesterov)
 
     def init(self, params):
-        if self.momentum == 0.0:
-            return ()
-        return jax.tree_util.tree_map(jnp.zeros_like, params)
+        vel = (() if self.momentum == 0.0
+               else jax.tree_util.tree_map(jnp.zeros_like, params))
+        if self._scheduled:
+            return SGDState(step=jnp.zeros((), jnp.int32), velocity=vel)
+        return vel
 
     def update(self, grads, state, params):
-        lr = self.learning_rate
+        if self._scheduled:
+            lr = self.learning_rate(state.step)
+            vel = state.velocity
+        else:
+            lr = self.learning_rate
+            vel = state
         if self.momentum == 0.0:
             new_params = jax.tree_util.tree_map(
                 lambda p, g: p - lr * g, params, grads)
-            return new_params, state
-        m = self.momentum
-        new_vel = jax.tree_util.tree_map(
-            lambda v, g: m * v - lr * g, state, grads)
-        if self.nesterov:
-            new_params = jax.tree_util.tree_map(
-                lambda p, v, g: p + m * v - lr * g, params, new_vel, grads)
+            new_vel = vel
         else:
-            new_params = jax.tree_util.tree_map(
-                lambda p, v: p + v, params, new_vel)
+            m = self.momentum
+            new_vel = jax.tree_util.tree_map(
+                lambda v, g: m * v - lr * g, vel, grads)
+            if self.nesterov:
+                new_params = jax.tree_util.tree_map(
+                    lambda p, v, g: p + m * v - lr * g,
+                    params, new_vel, grads)
+            else:
+                new_params = jax.tree_util.tree_map(
+                    lambda p, v: p + v, params, new_vel)
+        if self._scheduled:
+            return new_params, SGDState(step=state.step + 1, velocity=new_vel)
         return new_params, new_vel
 
 
@@ -73,9 +98,14 @@ class AdamState(NamedTuple):
 
 
 class Adam(Optimizer):
-    def __init__(self, learning_rate: float = 0.001, beta_1: float = 0.9,
+    """``learning_rate`` accepts a float or a schedule (evaluated at the
+    0-based completed-step count, i.e. first update sees schedule(0))."""
+
+    def __init__(self, learning_rate=0.001, beta_1: float = 0.9,
                  beta_2: float = 0.999, epsilon: float = 1e-7):
-        self.learning_rate = float(learning_rate)
+        from tpu_dist.ops import schedules
+
+        self.learning_rate, self._scheduled = schedules.resolve(learning_rate)
         self.beta_1 = float(beta_1)
         self.beta_2 = float(beta_2)
         self.epsilon = float(epsilon)
@@ -85,7 +115,9 @@ class Adam(Optimizer):
         return AdamState(step=jnp.zeros((), jnp.int32), mu=z(), nu=z())
 
     def update(self, grads, state, params):
-        b1, b2, eps, lr = self.beta_1, self.beta_2, self.epsilon, self.learning_rate
+        b1, b2, eps = self.beta_1, self.beta_2, self.epsilon
+        lr = (self.learning_rate(state.step) if self._scheduled
+              else self.learning_rate)
         step = state.step + 1
         mu = jax.tree_util.tree_map(
             lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)

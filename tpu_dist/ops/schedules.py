@@ -1,0 +1,124 @@
+"""Learning-rate schedules: pure functions of the step, evaluated IN-program.
+
+Keras-era surface (``tf.keras.optimizers.schedules``) rebuilt the TPU-native
+way: a schedule is a jit-traceable callable ``schedule(step) -> lr`` that the
+optimizer evaluates inside the compiled train step, so the learning rate
+changes every step with ZERO recompiles and zero host round-trips. (This is
+also why there is no ``LearningRateScheduler`` callback here: the Keras
+callback mutates the optimizer's lr from the host between epochs, which would
+invalidate the compiled step each time — a schedule expresses the same thing
+inside the program. The reference itself uses a constant lr,
+tf_dist_example.py:51.)
+
+    opt = SGD(learning_rate=ExponentialDecay(0.01, decay_steps=1000,
+                                             decay_rate=0.5))
+    model.compile(optimizer=opt, ...)
+
+Step counting is TF-compatible: the first update evaluates ``schedule(0)``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+
+class LearningRateSchedule:
+    """Base: subclasses implement ``__call__(step) -> lr`` with jnp ops only
+    (no Python control flow on ``step`` — it is traced)."""
+
+    def __call__(self, step):
+        raise NotImplementedError
+
+    def __repr__(self):
+        attrs = ", ".join(f"{k}={v}" for k, v in vars(self).items())
+        return f"{type(self).__name__}({attrs})"
+
+
+class ExponentialDecay(LearningRateSchedule):
+    """lr * decay_rate ** (step / decay_steps); ``staircase`` floors the
+    exponent to whole decay periods."""
+
+    def __init__(self, initial_learning_rate: float, decay_steps: int,
+                 decay_rate: float, staircase: bool = False):
+        self.initial_learning_rate = float(initial_learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.decay_rate = float(decay_rate)
+        self.staircase = bool(staircase)
+
+    def __call__(self, step):
+        p = jnp.asarray(step, jnp.float32) / self.decay_steps
+        if self.staircase:
+            p = jnp.floor(p)
+        return self.initial_learning_rate * self.decay_rate ** p
+
+
+class CosineDecay(LearningRateSchedule):
+    """Cosine annealing from the initial lr to ``alpha * initial`` over
+    ``decay_steps``, constant afterwards."""
+
+    def __init__(self, initial_learning_rate: float, decay_steps: int,
+                 alpha: float = 0.0):
+        self.initial_learning_rate = float(initial_learning_rate)
+        self.decay_steps = int(decay_steps)
+        self.alpha = float(alpha)
+
+    def __call__(self, step):
+        t = jnp.minimum(jnp.asarray(step, jnp.float32), self.decay_steps)
+        cos = 0.5 * (1 + jnp.cos(jnp.pi * t / self.decay_steps))
+        return self.initial_learning_rate * (
+            (1 - self.alpha) * cos + self.alpha)
+
+
+class PiecewiseConstantDecay(LearningRateSchedule):
+    """values[i] while step <= boundaries[i-1]..boundaries[i]; TF semantics:
+    len(values) == len(boundaries) + 1."""
+
+    def __init__(self, boundaries: Sequence[int], values: Sequence[float]):
+        if len(values) != len(boundaries) + 1:
+            raise ValueError(
+                f"need len(values) == len(boundaries) + 1, got "
+                f"{len(values)} values / {len(boundaries)} boundaries")
+        self.boundaries = [int(b) for b in boundaries]
+        self.values = [float(v) for v in values]
+
+    def __call__(self, step):
+        bounds = jnp.asarray(self.boundaries)
+        vals = jnp.asarray(self.values, jnp.float32)
+        # Index = number of boundaries the step has passed (step > b).
+        idx = jnp.sum(jnp.asarray(step) > bounds)
+        return vals[idx]
+
+
+class WarmupCosine(LearningRateSchedule):
+    """Linear warmup to ``peak`` over ``warmup_steps``, then cosine decay to
+    ``alpha * peak`` over the remaining ``decay_steps`` — the standard
+    large-batch TPU training schedule (not in the Keras zoo, provided
+    because every pod-scale recipe wants it)."""
+
+    def __init__(self, peak_learning_rate: float, warmup_steps: int,
+                 decay_steps: int, alpha: float = 0.0):
+        self.peak_learning_rate = float(peak_learning_rate)
+        self.warmup_steps = int(warmup_steps)
+        self.decay_steps = int(decay_steps)
+        self.alpha = float(alpha)
+
+    def __call__(self, step):
+        t = jnp.asarray(step, jnp.float32)
+        warm = self.peak_learning_rate * t / max(self.warmup_steps, 1)
+        d = jnp.clip((t - self.warmup_steps) / max(self.decay_steps, 1),
+                     0.0, 1.0)
+        cos = 0.5 * (1 + jnp.cos(jnp.pi * d))
+        decayed = self.peak_learning_rate * ((1 - self.alpha) * cos
+                                             + self.alpha)
+        return jnp.where(t < self.warmup_steps, warm, decayed)
+
+
+def resolve(learning_rate):
+    """(value, is_schedule): accept float or LearningRateSchedule/callable."""
+    if isinstance(learning_rate, LearningRateSchedule):
+        return learning_rate, True
+    if callable(learning_rate):
+        return learning_rate, True
+    return float(learning_rate), False

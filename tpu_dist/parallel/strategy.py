@@ -175,17 +175,7 @@ class Strategy:
         local_replicas = self.num_replicas_in_sync // jax.process_count()
 
         if local_replicas > 1:
-            import numpy as np
-
-            def _concat(elements):
-                first = elements[0]
-                if isinstance(first, tuple):
-                    return tuple(_concat([e[i] for e in elements])
-                                 for i in range(len(first)))
-                if isinstance(first, dict):
-                    return {k: _concat([e[k] for e in elements])
-                            for k in first}
-                return np.concatenate([np.asarray(e) for e in elements])
+            from tpu_dist.data.pipeline import _concat_structure
 
             inner = dataset  # capture BEFORE rebinding the name below
 
@@ -198,7 +188,7 @@ class Strategy:
                             group.append(next(it))
                     except StopIteration:
                         return
-                    yield _concat(group)
+                    yield _concat_structure(group)
 
             card = dataset.cardinality()
             dataset = Dataset(
@@ -285,9 +275,14 @@ class Strategy:
         if code is None:  # callable object — identity
             return fn
         cells = getattr(fn, "__closure__", None) or ()
+        # Bound methods delegate __code__/__closure__ to the function with
+        # `self` in neither — two instances' .step would collide without
+        # keying the receiver by identity.
+        receiver = getattr(fn, "__self__", None)
         try:
             key = (code, tuple(c.cell_contents for c in cells),
-                   getattr(fn, "__defaults__", None))
+                   getattr(fn, "__defaults__", None),
+                   id(receiver) if receiver is not None else None)
             hash(key)  # unhashable closure contents -> identity fallback
             return key
         except (TypeError, ValueError):  # unhashable / empty cell
