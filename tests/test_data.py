@@ -106,6 +106,68 @@ class TestCombinators:
         assert 0.0 <= xb.min() and xb.max() <= 1.0
 
 
+class TestMoreCombinators:
+    def test_skip(self):
+        ds = Dataset.range(10).skip(7)
+        assert list(ds.as_numpy_iterator()) == [7, 8, 9]
+        assert ds.cardinality() == 3
+        assert Dataset.range(3).skip(5).cardinality() == 0
+
+    def test_unbatch_roundtrips_batch(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        y = np.arange(6, dtype=np.int64)
+        ds = Dataset.from_tensor_slices((x, y)).batch(3).unbatch()
+        got = list(ds.as_numpy_iterator())
+        assert len(got) == 6
+        np.testing.assert_array_equal(got[4][0], x[4])
+        assert got[4][1] == y[4]
+
+    def test_concatenate(self):
+        ds = Dataset.range(3).concatenate(Dataset.range(2))
+        assert list(ds.as_numpy_iterator()) == [0, 1, 2, 0, 1]
+        assert ds.cardinality() == 5
+
+    def test_zip_stops_at_shortest(self):
+        a, b = Dataset.range(4), Dataset.range(2)
+        z = Dataset.zip(a, b)
+        assert list(z.as_numpy_iterator()) == [(0, 0), (1, 1)]
+        assert z.cardinality() == 2
+        # tuple-arg form, like tf.data.Dataset.zip((a, b))
+        assert list(Dataset.zip((a, b)).as_numpy_iterator()) == \
+            [(0, 0), (1, 1)]
+        with pytest.raises(ValueError, match="at least one"):
+            Dataset.zip()
+
+    def test_unbatch_dict_elements(self):
+        ds = Dataset.from_tensor_slices(
+            {"a": np.arange(6).reshape(3, 2)}).batch(3).unbatch()
+        got = list(ds.as_numpy_iterator())
+        assert len(got) == 3
+        np.testing.assert_array_equal(got[1]["a"], [2, 3])
+
+    def test_concatenate_is_opaque_to_file_sharding(self):
+        # Replaying concatenate through the FILE chain rewrite would append
+        # the full extra stream to every worker's shard; it must force the
+        # DATA fallback instead of crashing or duplicating.
+        ds = Dataset.range(6).concatenate(Dataset.range(2))
+        assert ds._transform is None
+
+    def test_zip_preserves_options(self):
+        a = Dataset.range(4)
+        opts = Options()
+        opts.experimental_distribute.auto_shard_policy = AutoShardPolicy.OFF
+        a = a.with_options(opts)
+        z = Dataset.zip(a, Dataset.range(4))
+        assert z.auto_shard_policy == AutoShardPolicy.OFF
+
+    def test_zip_then_batch_feeds_pipeline(self):
+        xs = Dataset.from_tensor_slices(np.arange(8, dtype=np.float32))
+        ys = Dataset.from_tensor_slices((np.arange(8) % 2).astype(np.int64))
+        batches = list(Dataset.zip(xs, ys).batch(4).as_numpy_iterator())
+        assert len(batches) == 2
+        np.testing.assert_array_equal(batches[0][0], [0, 1, 2, 3])
+
+
 class TestOptions:
     def test_reference_options_plumbing(self):
         # tf_dist_example.py:34-37 verbatim shape.

@@ -107,6 +107,20 @@ class TestLayers:
 
 
 class TestSequential:
+    def test_summary_matches_keras_param_count(self):
+        # The reference CNN's well-known Keras total: 225,034 params.
+        import tpu_dist as td
+
+        out = td.models.build_and_compile_cnn_model().summary()
+        assert "Trainable params: 225,034" in out
+        assert "(26, 26, 32)" in out and "(1600,)" in out
+
+    def test_summary_without_input_shape(self):
+        from tpu_dist.models import Dense, Sequential
+
+        out = Sequential([Dense(4)]).summary()
+        assert "input_shape unknown" in out
+
     def test_reference_cnn_has_8_variables(self):
         # SURVEY.md §3.2/§3.5: exactly 8 model variables observed in the
         # reference run (2x conv kernel+bias, 2x dense kernel+bias).

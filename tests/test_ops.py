@@ -47,6 +47,105 @@ class TestLosses:
         assert float(loss) < 1e-6
 
 
+class TestExtendedLosses:
+    def test_binary_crossentropy_logits_matches_probs_path(self):
+        from tpu_dist.ops.losses import BinaryCrossentropy
+
+        logits = jnp.array([[2.0], [-1.0], [0.5]])
+        targets = jnp.array([[1.0], [0.0], [1.0]])
+        from_logits = BinaryCrossentropy(from_logits=True)(logits, targets)
+        probs = jax.nn.sigmoid(logits)
+        from_probs = BinaryCrossentropy()(probs, targets)
+        np.testing.assert_allclose(float(from_logits), float(from_probs),
+                                   rtol=1e-5)
+
+    def test_binary_crossentropy_extreme_logits_stable(self):
+        from tpu_dist.ops.losses import BinaryCrossentropy
+
+        logits = jnp.array([[500.0], [-500.0]])
+        targets = jnp.array([[1.0], [0.0]])
+        val = float(BinaryCrossentropy(from_logits=True)(logits, targets))
+        assert np.isfinite(val) and val < 1e-6
+
+    def test_huber_quadratic_and_linear_regions(self):
+        from tpu_dist.ops.losses import Huber
+
+        preds = jnp.array([[0.5], [3.0]])
+        targets = jnp.array([[0.0], [0.0]])
+        # |0.5| < delta: 0.5*0.25 ; |3| > delta: 1*(3-0.5) = 2.5
+        val = float(Huber(delta=1.0)(preds, targets))
+        np.testing.assert_allclose(val, (0.125 + 2.5) / 2, rtol=1e-6)
+
+    def test_mae(self):
+        from tpu_dist.ops.losses import MeanAbsoluteError
+
+        val = float(MeanAbsoluteError()(jnp.array([[1.0], [-2.0]]),
+                                        jnp.array([[0.0], [0.0]])))
+        np.testing.assert_allclose(val, 1.5, rtol=1e-6)
+
+    def test_new_string_identifiers(self):
+        for name in ("mae", "binary_crossentropy", "huber"):
+            assert losses.get(name) is not None
+
+    def test_binary_shapes_align_not_broadcast(self):
+        # [B] labels against a [B, 1] single-logit head must align, never
+        # silently broadcast into a [B, B] matrix (the classic bug).
+        from tpu_dist.ops.losses import BinaryCrossentropy
+        from tpu_dist.ops.metrics import BinaryAccuracy
+
+        logits = jnp.array([[4.0], [-4.0], [4.0]])
+        labels = jnp.array([1, 0, 0])
+        loss = float(BinaryCrossentropy(from_logits=True)(logits, labels))
+        # rows 0,1 nearly perfect; row 2 wrong by ~4 nats -> mean ~4/3
+        np.testing.assert_allclose(loss, 4.0 / 3, rtol=0.02)
+        m = BinaryAccuracy(threshold=0.0)
+        s = m.update(m.init(), logits, labels)
+        assert float(m.result(s)) == pytest.approx(2 / 3)
+        with pytest.raises(ValueError, match="disagree"):
+            BinaryCrossentropy()(jnp.zeros((3, 2)), jnp.zeros((4, 2)))
+
+
+class TestExtendedMetrics:
+    def test_categorical_accuracy(self):
+        from tpu_dist.ops.metrics import CategoricalAccuracy
+
+        m = CategoricalAccuracy()
+        s = m.update(m.init(), jnp.array([[0.9, 0.1], [0.2, 0.8]]),
+                     jnp.array([[1.0, 0.0], [1.0, 0.0]]))
+        assert float(m.result(s)) == pytest.approx(0.5)
+
+    def test_binary_accuracy_threshold(self):
+        from tpu_dist.ops.metrics import BinaryAccuracy
+
+        m = BinaryAccuracy(threshold=0.5)
+        s = m.update(m.init(), jnp.array([0.7, 0.3, 0.6]),
+                     jnp.array([1, 0, 0]))
+        assert float(m.result(s)) == pytest.approx(2 / 3)
+
+    def test_top_k(self):
+        from tpu_dist.ops.metrics import SparseTopKCategoricalAccuracy
+
+        m = SparseTopKCategoricalAccuracy(k=2)
+        logits = jnp.array([[0.1, 0.5, 0.4], [0.9, 0.05, 0.05]])
+        s = m.update(m.init(), logits, jnp.array([2, 1]))
+        # Row 0: top-2 = {1, 2} contains 2; row 1: top-2 = {0, 2}... label 1
+        # is NOT in {0, then max of rest}: top-2 of [0.9,.05,.05] = {0, 1 or
+        # 2 by tie}; jax.lax.top_k breaks ties by index -> {0, 1}: hit.
+        assert float(m.result(s)) == pytest.approx(1.0)
+
+    def test_sum_metric(self):
+        from tpu_dist.ops.metrics import Sum
+
+        m = Sum()
+        s = m.update(m.update(m.init(), jnp.float32(2.0)), jnp.float32(3.0))
+        assert float(m.result(s)) == pytest.approx(5.0)
+
+    def test_new_string_identifiers(self):
+        for name in ("categorical_accuracy", "binary_accuracy",
+                     "sparse_top_k_categorical_accuracy"):
+            assert metrics.get(name) is not None
+
+
 class TestMetrics:
     def test_accuracy_accumulates_across_updates(self):
         m = SparseCategoricalAccuracy()

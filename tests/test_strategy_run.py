@@ -171,6 +171,11 @@ class TestStrategyRun:
         out_b = strategy.reduce("sum", strategy.run(b.step, args=(xb,)))
         np.testing.assert_allclose(float(out_a), x.sum())
         np.testing.assert_allclose(float(out_b), 10 * x.sum())
+        # Mutating a (hashable-attr) receiver must recompile, not serve the
+        # stale program with the old value baked in.
+        a.s = 3.0
+        out_a2 = strategy.reduce("sum", strategy.run(a.step, args=(xb,)))
+        np.testing.assert_allclose(float(out_a2), 3 * x.sum())
 
     def test_reduce_pytree_outputs(self, eight_devices):
         # The documented run-then-reduce idiom must work on dict outputs.

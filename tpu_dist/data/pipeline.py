@@ -336,6 +336,81 @@ class Dataset:
         return self._derive(factory, cardinality=card,
                             transform=("take", {"count": count}))
 
+    def skip(self, count: int) -> "Dataset":
+        """Drop the first ``count`` elements — tf.data's ``Dataset.skip``."""
+        def factory():
+            yield from itertools.islice(self._it_factory(), count, None)
+
+        card = (None if self._cardinality is None
+                else max(0, self._cardinality - count))
+        return self._derive(factory, cardinality=card,
+                            transform=("skip", {"count": count}))
+
+    def unbatch(self) -> "Dataset":
+        """Split each batched element back into per-example elements —
+        tf.data's ``Dataset.unbatch`` (leading dim must agree across the
+        element's components)."""
+        def first_leaf(el):
+            if isinstance(el, tuple):
+                return first_leaf(el[0])
+            if isinstance(el, dict):
+                return first_leaf(next(iter(el.values())))
+            return el
+
+        def factory():
+            for el in self._it_factory():
+                n = len(np.asarray(first_leaf(el)))
+                for i in range(n):
+                    yield _map_structure(lambda a: np.asarray(a)[i], el)
+
+        return self._derive(factory, cardinality=None,
+                            transform=("unbatch", {}))
+
+    def concatenate(self, other: "Dataset") -> "Dataset":
+        """This dataset's elements, then ``other``'s — tf.data's
+        ``Dataset.concatenate``."""
+        def factory():
+            yield from self._it_factory()
+            yield from iter(other)
+
+        card = None
+        other_card = other.cardinality()
+        if (self._cardinality is not None and other_card is not None
+                and other_card >= 0):
+            card = self._cardinality + other_card
+        # transform=None: replaying concatenate through the FILE-autoshard
+        # chain rewrite would append the FULL `other` to every worker's file
+        # shard (duplicated data); opaque forces the DATA fallback instead.
+        return self._derive(factory, cardinality=card, transform=None)
+
+    @staticmethod
+    def zip(*datasets: "Dataset") -> "Dataset":
+        """Element-wise tuples across datasets, stopping at the shortest —
+        tf.data's ``Dataset.zip`` (accepts ``Dataset.zip((a, b))`` too)."""
+        if len(datasets) == 1 and isinstance(datasets[0], (tuple, list)):
+            datasets = tuple(datasets[0])
+        if not datasets:
+            raise ValueError("zip needs at least one dataset")
+
+        def factory():
+            its = [iter(d) for d in datasets]
+            while True:
+                row = []
+                for it in its:
+                    try:
+                        row.append(next(it))
+                    except StopIteration:
+                        return
+                yield tuple(row)
+
+        cards = [d.cardinality() for d in datasets]
+        card = (min(c for c in cards) if all(
+            c is not None and c >= 0 for c in cards) else None)
+        # Keep the first input's options (shard policy etc.) — a raw Dataset
+        # would silently reset auto_shard_policy to AUTO.
+        first_opts = getattr(datasets[0], "_options", None)
+        return Dataset(factory, options=first_opts, cardinality=card)
+
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Every ``num_shards``-th element starting at ``index`` — tf.data's
         ``Dataset.shard``, the primitive DATA autosharding lowers to."""

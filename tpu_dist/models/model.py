@@ -245,9 +245,39 @@ class Sequential(Model):
         return y, new_state
 
     def summary(self) -> str:
-        lines = [f'Model: "{self.name}"', "-" * 46]
-        for name, layer in zip(self.layer_names, self.layers):
-            lines.append(f"{name:<28}{type(layer).__name__}")
+        """Keras-style layer table: name, type, output shape, param count
+        (shapes/counts need a known ``input_shape``; the dry per-layer init
+        used to derive them is host-side and tiny)."""
+        header = f"{'Layer (name)':<26}{'Type':<22}{'Output shape':<18}{'Params':>10}"
+        lines = [f'Model: "{self.name}"', "=" * len(header), header,
+                 "-" * len(header)]
+        if self.input_shape is None:
+            for name, layer in zip(self.layer_names, self.layers):
+                lines.append(f"{name:<26}{type(layer).__name__:<22}"
+                             f"{'?':<18}{'?':>10}")
+            lines.append("-" * len(header))
+            lines.append("(input_shape unknown — shapes/params unavailable)")
+        else:
+            import math
+
+            def count(tree):
+                return sum(math.prod(a.shape) for a in
+                           jax.tree_util.tree_leaves(tree))
+
+            key = jax.random.PRNGKey(0)
+            shape = tuple(self.input_shape)
+            total = total_state = 0
+            for name, layer in zip(self.layer_names, self.layers):
+                params, state, shape = layer.init(key, shape)
+                n = count(params)
+                total += n
+                total_state += count(state)
+                lines.append(f"{name:<26}{type(layer).__name__:<22}"
+                             f"{str(tuple(shape)):<18}{n:>10,}")
+            lines.append("-" * len(header))
+            lines.append(f"Trainable params: {total:,}")
+            if total_state:
+                lines.append(f"Non-trainable state: {total_state:,}")
         out = "\n".join(lines)
         print(out)
         return out

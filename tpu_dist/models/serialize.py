@@ -159,9 +159,16 @@ def save_model(model, directory) -> None:
     step 0). Safe in multi-process jobs: non-chief processes write nothing
     but participate in nothing either — saving has no collective."""
     from tpu_dist.cluster import bootstrap
+    from tpu_dist.models.model import Sequential
     from tpu_dist.training import checkpoint
     from tpu_dist.training.trainer import Trainer
 
+    # Type check on EVERY process before any side effects: a chief-only
+    # failure here would leave non-chief processes blocked at the
+    # checkpoint barrier below.
+    if not isinstance(model, Sequential):
+        raise TypeError(
+            f"save/load supports Sequential models, got {type(model).__name__}")
     directory = pathlib.Path(directory)
     if model._trainer is None:
         model._trainer = Trainer(model)

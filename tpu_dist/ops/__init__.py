@@ -2,12 +2,23 @@
 
 from tpu_dist.ops import initializers, losses, metrics, optimizers, schedules
 from tpu_dist.ops.losses import (
+    BinaryCrossentropy,
     CategoricalCrossentropy,
+    Huber,
+    MeanAbsoluteError,
     Loss,
     MeanSquaredError,
     SparseCategoricalCrossentropy,
 )
-from tpu_dist.ops.metrics import Mean, Metric, SparseCategoricalAccuracy
+from tpu_dist.ops.metrics import (
+    BinaryAccuracy,
+    CategoricalAccuracy,
+    Mean,
+    Metric,
+    SparseCategoricalAccuracy,
+    SparseTopKCategoricalAccuracy,
+    Sum,
+)
 from tpu_dist.ops.optimizers import SGD, Adam, Optimizer, OptaxWrapper
 from tpu_dist.ops.schedules import (
     CosineDecay,
@@ -23,12 +34,19 @@ __all__ = [
     "metrics",
     "optimizers",
     "schedules",
+    "BinaryCrossentropy",
     "CategoricalCrossentropy",
+    "Huber",
+    "MeanAbsoluteError",
     "Loss",
     "MeanSquaredError",
     "SparseCategoricalCrossentropy",
+    "BinaryAccuracy",
+    "CategoricalAccuracy",
     "Mean",
     "Metric",
+    "SparseTopKCategoricalAccuracy",
+    "Sum",
     "SparseCategoricalAccuracy",
     "SGD",
     "Adam",

@@ -36,6 +36,47 @@ def mean_squared_error(preds, targets):
     return jnp.mean(jnp.square(preds - targets), axis=-1)
 
 
+def mean_absolute_error(preds, targets):
+    return jnp.mean(jnp.abs(preds - targets), axis=-1)
+
+
+def _align_binary_shapes(preds, targets):
+    """[B] targets against [B, 1] preds (the standard single-logit head):
+    insert the trailing axis instead of letting broadcasting silently build
+    a [B, B] matrix — the Keras shape-matching behavior."""
+    if targets.ndim == preds.ndim - 1 and preds.shape[-1] == 1:
+        targets = targets[..., None]
+    if preds.shape != jnp.broadcast_shapes(preds.shape, targets.shape):
+        raise ValueError(
+            f"binary loss/metric shapes disagree: preds {preds.shape} vs "
+            f"targets {targets.shape}")
+    return targets
+
+
+def binary_crossentropy(preds, targets, *, from_logits: bool = False):
+    """Per-example BCE averaged over the trailing dim: [B, ...] x [B, ...]
+    (or [B] targets against a [B, 1] single-logit head)."""
+    targets = _align_binary_shapes(preds, jnp.asarray(targets))
+    targets = targets.astype(preds.dtype)
+    if from_logits:
+        # log-sum-exp form: stable for large |logits|.
+        per = (jnp.maximum(preds, 0) - preds * targets
+               + jnp.log1p(jnp.exp(-jnp.abs(preds))))
+    else:
+        p = jnp.clip(preds, 1e-7, 1 - 1e-7)
+        per = -(targets * jnp.log(p) + (1 - targets) * jnp.log1p(-p))
+    return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
+def huber(preds, targets, *, delta: float = 1.0):
+    """Quadratic within ±delta, linear outside — tf.keras.losses.Huber."""
+    err = preds - targets
+    abs_err = jnp.abs(err)
+    quad = jnp.minimum(abs_err, delta)
+    per = 0.5 * quad ** 2 + delta * (abs_err - quad)
+    return per.reshape(per.shape[0], -1).mean(axis=-1)
+
+
 class Loss:
     """Callable loss object with a Keras-compatible constructor surface."""
 
@@ -96,6 +137,28 @@ class MeanSquaredError(Loss):
         super().__init__(mean_squared_error, "mean_squared_error")
 
 
+class MeanAbsoluteError(Loss):
+    def __init__(self):
+        super().__init__(mean_absolute_error, "mean_absolute_error")
+
+
+class BinaryCrossentropy(Loss):
+    def __init__(self, from_logits: bool = False):
+        super().__init__(
+            lambda preds, targets: binary_crossentropy(
+                preds, targets, from_logits=from_logits),
+            "binary_crossentropy")
+        self.from_logits = from_logits
+
+
+class Huber(Loss):
+    def __init__(self, delta: float = 1.0):
+        super().__init__(
+            lambda preds, targets: huber(preds, targets, delta=delta),
+            "huber")
+        self.delta = float(delta)
+
+
 def get(identifier) -> Loss:
     if isinstance(identifier, Loss):
         return identifier
@@ -111,6 +174,11 @@ def get(identifier) -> Loss:
             lambda: CategoricalCrossentropy(from_logits=False),
         "mse": MeanSquaredError,
         "mean_squared_error": MeanSquaredError,
+        "mae": MeanAbsoluteError,
+        "mean_absolute_error": MeanAbsoluteError,
+        "binary_crossentropy":
+            lambda: BinaryCrossentropy(from_logits=False),
+        "huber": Huber,
     }
     if isinstance(identifier, str) and identifier in table:
         return table[identifier]()

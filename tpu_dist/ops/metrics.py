@@ -17,6 +17,7 @@ from __future__ import annotations
 
 from typing import Any, Mapping
 
+import jax
 import jax.numpy as jnp
 
 MetricState = Mapping[str, Any]
@@ -52,6 +53,50 @@ class SparseCategoricalAccuracy(Metric):
                 "count": state["count"] + jnp.float32(correct.size)}
 
 
+class CategoricalAccuracy(Metric):
+    """Accuracy against one-hot labels: argmax(logits) == argmax(labels)."""
+
+    def __init__(self, name: str = "categorical_accuracy"):
+        self.name = name
+
+    def update(self, state, logits, onehot):
+        correct = (jnp.argmax(logits, axis=-1) == jnp.argmax(onehot, axis=-1))
+        return {"total": state["total"] + correct.sum().astype(jnp.float32),
+                "count": state["count"] + jnp.float32(correct.size)}
+
+
+class BinaryAccuracy(Metric):
+    """Thresholded accuracy for sigmoid/binary heads."""
+
+    def __init__(self, threshold: float = 0.5, name: str = "binary_accuracy"):
+        self.threshold = float(threshold)
+        self.name = name
+
+    def update(self, state, preds, labels):
+        from tpu_dist.ops.losses import _align_binary_shapes
+
+        labels = _align_binary_shapes(preds, jnp.asarray(labels))
+        hits = ((preds > self.threshold).astype(jnp.int32)
+                == labels.astype(jnp.int32))
+        return {"total": state["total"] + hits.sum().astype(jnp.float32),
+                "count": state["count"] + jnp.float32(hits.size)}
+
+
+class SparseTopKCategoricalAccuracy(Metric):
+    """Label within the top-k logits — tf.keras SparseTopKCategoricalAccuracy
+    (default k=5)."""
+
+    def __init__(self, k: int = 5, name: str = "top_k_accuracy"):
+        self.k = int(k)
+        self.name = name
+
+    def update(self, state, logits, labels):
+        _, top = jax.lax.top_k(logits, self.k)
+        hit = (top == labels[..., None].astype(top.dtype)).any(axis=-1)
+        return {"total": state["total"] + hit.sum().astype(jnp.float32),
+                "count": state["count"] + jnp.float32(hit.size)}
+
+
 class Mean(Metric):
     """Streaming mean — used for the loss channel of the progress bar."""
 
@@ -64,6 +109,21 @@ class Mean(Metric):
                 "count": state["count"] + w}
 
 
+class Sum(Metric):
+    """Streaming sum (result ignores the count)."""
+
+    def __init__(self, name: str = "sum"):
+        self.name = name
+
+    def update(self, state, value, weight=None):
+        w = jnp.float32(1.0) if weight is None else jnp.float32(weight)
+        return {"total": state["total"] + jnp.asarray(value, jnp.float32) * w,
+                "count": state["count"] + w}
+
+    def result(self, state):
+        return state["total"]
+
+
 def get(identifier) -> Metric:
     if isinstance(identifier, Metric):
         return identifier
@@ -71,6 +131,10 @@ def get(identifier) -> Metric:
         "accuracy": lambda: SparseCategoricalAccuracy(),
         "sparse_categorical_accuracy": lambda: SparseCategoricalAccuracy(
             name="sparse_categorical_accuracy"),
+        "categorical_accuracy": CategoricalAccuracy,
+        "binary_accuracy": BinaryAccuracy,
+        "sparse_top_k_categorical_accuracy": SparseTopKCategoricalAccuracy,
+        "top_k_accuracy": SparseTopKCategoricalAccuracy,
     }
     if isinstance(identifier, str) and identifier in table:
         return table[identifier]()

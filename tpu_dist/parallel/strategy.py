@@ -276,13 +276,23 @@ class Strategy:
             return fn
         cells = getattr(fn, "__closure__", None) or ()
         # Bound methods delegate __code__/__closure__ to the function with
-        # `self` in neither — two instances' .step would collide without
-        # keying the receiver by identity.
+        # `self` in neither. Key the receiver by its attribute VALUES (same
+        # semantics as closure cells: changed values recompile, equal values
+        # hit the cache); receivers with unhashable attrs key by identity —
+        # there, like tf.function, attribute mutation does NOT retrace.
         receiver = getattr(fn, "__self__", None)
+        if receiver is not None:
+            try:
+                rkey = (type(receiver),
+                        tuple(sorted(vars(receiver).items())))
+                hash(rkey)
+            except (TypeError, ValueError):
+                rkey = ("id", id(receiver))
+        else:
+            rkey = None
         try:
             key = (code, tuple(c.cell_contents for c in cells),
-                   getattr(fn, "__defaults__", None),
-                   id(receiver) if receiver is not None else None)
+                   getattr(fn, "__defaults__", None), rkey)
             hash(key)  # unhashable closure contents -> identity fallback
             return key
         except (TypeError, ValueError):  # unhashable / empty cell
