@@ -46,7 +46,11 @@ def _align_binary_shapes(preds, targets):
     a [B, B] matrix — the Keras shape-matching behavior."""
     if targets.ndim == preds.ndim - 1 and preds.shape[-1] == 1:
         targets = targets[..., None]
-    if preds.shape != jnp.broadcast_shapes(preds.shape, targets.shape):
+    try:
+        ok = preds.shape == jnp.broadcast_shapes(preds.shape, targets.shape)
+    except TypeError:  # incompatible ranks/dims
+        ok = False
+    if not ok:
         raise ValueError(
             f"binary loss/metric shapes disagree: preds {preds.shape} vs "
             f"targets {targets.shape}")
