@@ -48,7 +48,7 @@ def _align_binary_shapes(preds, targets):
         targets = targets[..., None]
     try:
         ok = preds.shape == jnp.broadcast_shapes(preds.shape, targets.shape)
-    except TypeError:  # incompatible ranks/dims
+    except (TypeError, ValueError):  # incompatible ranks/dims
         ok = False
     if not ok:
         raise ValueError(
