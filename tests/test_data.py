@@ -160,6 +160,39 @@ class TestMoreCombinators:
         z = Dataset.zip(a, Dataset.range(4))
         assert z.auto_shard_policy == AutoShardPolicy.OFF
 
+    def test_interleave_round_robin(self):
+        # Each element maps to a 3-element stream; cycle 2 alternates them.
+        ds = Dataset.range(2).interleave(
+            lambda i: Dataset.range(3).map(lambda j: int(i) * 10 + j),
+            cycle_length=2)
+        assert list(ds.as_numpy_iterator()) == [0, 10, 1, 11, 2, 12]
+
+    def test_interleave_uneven_streams_tf_ordering(self):
+        # tf.data semantics: the replacement stream takes over the
+        # exhausted stream's SLOT (and continues its block), so uneven
+        # stream lengths keep the deterministic mix.
+        lengths = {0: 1, 1: 2, 2: 1}
+        ds = Dataset.range(3).interleave(
+            lambda i: Dataset.range(lengths[int(i)]).map(
+                lambda j, i=i: int(i) * 10 + j),
+            cycle_length=2)
+        assert list(ds.as_numpy_iterator()) == [0, 10, 20, 11]
+
+    def test_interleave_is_file_shard_replayable(self):
+        ds = Dataset.range(4).interleave(lambda i: Dataset.range(2),
+                                         cycle_length=2)
+        assert ds._transform is not None and ds._transform[0] == "interleave"
+
+    def test_interleave_block_length_and_refill(self):
+        ds = Dataset.range(3).interleave(
+            lambda i: Dataset.range(2).map(lambda j: int(i) * 10 + j),
+            cycle_length=2, block_length=2)
+        # Streams 0 and 1 drain fully (block 2 each), then stream 2 opens.
+        assert list(ds.as_numpy_iterator()) == [0, 1, 10, 11, 20, 21]
+        with pytest.raises(ValueError, match=">= 1"):
+            Dataset.range(2).interleave(lambda i: Dataset.range(1),
+                                        cycle_length=0)
+
     def test_zip_then_batch_feeds_pipeline(self):
         xs = Dataset.from_tensor_slices(np.arange(8, dtype=np.float32))
         ys = Dataset.from_tensor_slices((np.arange(8) % 2).astype(np.int64))

@@ -336,6 +336,61 @@ class Dataset:
         return self._derive(factory, cardinality=card,
                             transform=("take", {"count": count}))
 
+    def interleave(self, map_func: Callable, cycle_length: int = 4,
+                   block_length: int = 1) -> "Dataset":
+        """tf.data's ``Dataset.interleave``: map each element to a Dataset
+        and consume the resulting streams round-robin — ``block_length``
+        elements at a time from ``cycle_length`` concurrently-open streams.
+        The standard shape for mixing multiple file readers."""
+        if cycle_length < 1 or block_length < 1:
+            raise ValueError("cycle_length and block_length must be >= 1")
+
+        def factory():
+            source = self._it_factory()
+
+            def new_stream():
+                try:
+                    el = next(source)
+                except StopIteration:
+                    return None
+                return iter(map_func(*el) if isinstance(el, tuple)
+                            else map_func(el))
+
+            slots: list = []
+            while len(slots) < cycle_length:
+                s = new_stream()
+                if s is None:
+                    break
+                slots.append(s)
+            # tf.data ordering: an exhausted stream's SLOT is taken over by
+            # the next input's stream, which continues the current block —
+            # uneven stream lengths keep the documented deterministic mix.
+            i = 0
+            while slots:
+                if i >= len(slots):
+                    i = 0
+                emitted = 0
+                removed = False
+                while emitted < block_length:
+                    try:
+                        yield next(slots[i])
+                        emitted += 1
+                    except StopIteration:
+                        repl = new_stream()
+                        if repl is None:
+                            slots.pop(i)
+                            removed = True
+                            break
+                        slots[i] = repl
+                if not removed:
+                    i += 1
+
+        return self._derive(
+            factory, cardinality=None,
+            transform=("interleave", {"map_func": map_func,
+                                      "cycle_length": cycle_length,
+                                      "block_length": block_length}))
+
     def skip(self, count: int) -> "Dataset":
         """Drop the first ``count`` elements — tf.data's ``Dataset.skip``."""
         def factory():

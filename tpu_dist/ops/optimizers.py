@@ -21,12 +21,59 @@ import jax.numpy as jnp
 
 
 class Optimizer:
+    #: Keras-style clipping knobs, applied to the (already all-reduced)
+    #: gradients before the update rule — set via constructor kwargs on the
+    #: concrete optimizers. At most one may be set (the Keras contract).
+    clipnorm: float | None = None
+    clipvalue: float | None = None
+    global_clipnorm: float | None = None
+
     def init(self, params) -> Any:
         raise NotImplementedError
 
     def update(self, grads, state, params) -> tuple[Any, Any]:
         """Returns (new_params, new_state)."""
         raise NotImplementedError
+
+    def _set_clipping(self, clipnorm=None, clipvalue=None,
+                      global_clipnorm=None):
+        if sum(x is not None for x in
+               (clipnorm, clipvalue, global_clipnorm)) > 1:
+            raise ValueError(
+                "at most one of clipnorm/clipvalue/global_clipnorm may be "
+                "set")
+        for name, x in (("clipnorm", clipnorm), ("clipvalue", clipvalue),
+                        ("global_clipnorm", global_clipnorm)):
+            if x is not None and float(x) <= 0:
+                raise ValueError(f"{name} must be > 0, got {x}")
+        self.clipnorm = None if clipnorm is None else float(clipnorm)
+        self.clipvalue = None if clipvalue is None else float(clipvalue)
+        self.global_clipnorm = (None if global_clipnorm is None
+                                else float(global_clipnorm))
+
+    def _clip(self, grads):
+        """Keras semantics: clipnorm rescales each tensor to its own norm
+        cap; global_clipnorm rescales everything by the joint norm;
+        clipvalue clamps elementwise."""
+        if self.clipvalue is not None:
+            c = self.clipvalue
+            return jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, -c, c), grads)
+        if self.clipnorm is not None:
+            c = self.clipnorm
+
+            def per_tensor(g):
+                n = jnp.sqrt(jnp.sum(jnp.square(g)))
+                return g * jnp.minimum(1.0, c / jnp.maximum(n, 1e-12))
+
+            return jax.tree_util.tree_map(per_tensor, grads)
+        if self.global_clipnorm is not None:
+            c = self.global_clipnorm
+            leaves = jax.tree_util.tree_leaves(grads)
+            n = jnp.sqrt(sum(jnp.sum(jnp.square(g)) for g in leaves))
+            scale = jnp.minimum(1.0, c / jnp.maximum(n, 1e-12))
+            return jax.tree_util.tree_map(lambda g: g * scale, grads)
+        return grads
 
     def __repr__(self):
         attrs = ", ".join(f"{k}={v}" for k, v in vars(self).items()
@@ -50,12 +97,14 @@ class SGD(Optimizer):
     in-program per step; TF semantics: first update sees schedule(0))."""
 
     def __init__(self, learning_rate=0.01, momentum: float = 0.0,
-                 nesterov: bool = False):
+                 nesterov: bool = False, clipnorm=None, clipvalue=None,
+                 global_clipnorm=None):
         from tpu_dist.ops import schedules
 
         self.learning_rate, self._scheduled = schedules.resolve(learning_rate)
         self.momentum = float(momentum)
         self.nesterov = bool(nesterov)
+        self._set_clipping(clipnorm, clipvalue, global_clipnorm)
 
     def init(self, params):
         vel = (() if self.momentum == 0.0
@@ -65,6 +114,7 @@ class SGD(Optimizer):
         return vel
 
     def update(self, grads, state, params):
+        grads = self._clip(grads)
         if self._scheduled:
             lr = self.learning_rate(state.step)
             vel = state.velocity
@@ -102,19 +152,22 @@ class Adam(Optimizer):
     0-based completed-step count, i.e. first update sees schedule(0))."""
 
     def __init__(self, learning_rate=0.001, beta_1: float = 0.9,
-                 beta_2: float = 0.999, epsilon: float = 1e-7):
+                 beta_2: float = 0.999, epsilon: float = 1e-7,
+                 clipnorm=None, clipvalue=None, global_clipnorm=None):
         from tpu_dist.ops import schedules
 
         self.learning_rate, self._scheduled = schedules.resolve(learning_rate)
         self.beta_1 = float(beta_1)
         self.beta_2 = float(beta_2)
         self.epsilon = float(epsilon)
+        self._set_clipping(clipnorm, clipvalue, global_clipnorm)
 
     def init(self, params):
         z = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
         return AdamState(step=jnp.zeros((), jnp.int32), mu=z(), nu=z())
 
     def update(self, grads, state, params):
+        grads = self._clip(grads)
         b1, b2, eps = self.beta_1, self.beta_2, self.epsilon
         lr = (self.learning_rate(state.step) if self._scheduled
               else self.learning_rate)
