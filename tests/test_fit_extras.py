@@ -26,6 +26,107 @@ def _ds(n=128, batch=32, seed=1):
     return Dataset.from_tensor_slices((x, y.astype(np.int64))).batch(batch)
 
 
+class TestClassWeight:
+    def test_weighted_loss_matches_manual(self, eight_devices):
+        # One deterministic batch: weighted epoch loss must equal
+        # mean(per_example * table[y]) computed by hand.
+        from tpu_dist.ops.losses import sparse_categorical_crossentropy
+
+        m = _model(lr=0.0)  # lr 0: params frozen, loss is pure measurement
+        rng = np.random.default_rng(0)
+        y = (np.arange(32) % 4).astype(np.int64)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        ds = Dataset.from_tensor_slices((x, y)).batch(32)
+        cw = {0: 2.0, 1: 1.0, 2: 0.5, 3: 1.0}
+
+        hist = m.fit(ds, epochs=1, steps_per_epoch=1, verbose=0,
+                     class_weight=cw)
+        v = m.variables
+        logits, _ = m.apply(v["params"], v["state"], x, training=True,
+                            rng=None)
+        per = np.asarray(sparse_categorical_crossentropy(
+            logits, y, from_logits=True))
+        table = np.array([cw[i] for i in range(4)], np.float32)
+        expected = float((per * table[y]).mean())
+        # training=True with rng=None matches the fit step (no dropout here).
+        assert hist.history["loss"][0] == pytest.approx(expected, rel=1e-5)
+
+    def test_class_weight_steers_training(self, eight_devices):
+        # Weighting class 0 at 100x makes the model favor it on ambiguous
+        # data relative to an unweighted run.
+        rng = np.random.default_rng(3)
+        y = (np.arange(256) % 2).astype(np.int64)
+        x = rng.normal(0, 1.0, (256, 8)).astype(np.float32)  # no signal
+        ds = Dataset.from_tensor_slices((x, y)).batch(64)
+
+        preds = {}
+        for name, cw in (("plain", None), ("weighted", {0: 100.0, 1: 1.0})):
+            m = _model(lr=0.5)
+            m.fit(ds, epochs=2, steps_per_epoch=4, verbose=0,
+                  class_weight=cw)
+            p = np.asarray(m.predict(x))
+            preds[name] = (p.argmax(-1) == 0).mean()
+        assert preds["weighted"] > preds["plain"]
+        assert preds["weighted"] > 0.9
+
+    def test_unlisted_classes_default_to_weight_one(self, eight_devices):
+        # Regression: a lookup table sized to the dict would CLAMP labels
+        # above the largest weighted class; unlisted classes must weigh 1.0.
+        from tpu_dist.ops.losses import sparse_categorical_crossentropy
+
+        m = _model(lr=0.0)
+        rng = np.random.default_rng(1)
+        y = (np.arange(32) % 4).astype(np.int64)  # classes 0..3
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        ds = Dataset.from_tensor_slices((x, y)).batch(32)
+        hist = m.fit(ds, epochs=1, steps_per_epoch=1, verbose=0,
+                     class_weight={0: 3.0})  # classes 1-3 unlisted
+        v = m.variables
+        logits, _ = m.apply(v["params"], v["state"], x, training=True,
+                            rng=None)
+        per = np.asarray(sparse_categorical_crossentropy(
+            logits, y, from_logits=True))
+        w = np.where(y == 0, 3.0, 1.0)
+        assert hist.history["loss"][0] == pytest.approx(
+            float((per * w).mean()), rel=1e-5)
+
+    def test_empty_class_weight_means_none(self, eight_devices):
+        m = _model()
+        ds = _ds()
+        hist = m.fit(ds, epochs=1, steps_per_epoch=2, verbose=0,
+                     class_weight={})
+        assert np.isfinite(hist.history["loss"][0])
+
+    def test_class_weight_rejects_onehot_labels(self, eight_devices):
+        from tpu_dist.ops import CategoricalCrossentropy
+
+        m = Sequential([Dense(4)], input_shape=(8,))
+        m.compile(loss=CategoricalCrossentropy(from_logits=True),
+                  optimizer=SGD(0.1))
+        y = np.eye(4, dtype=np.float32)[np.arange(32) % 4]
+        x = np.random.default_rng(0).normal(size=(32, 8)).astype(np.float32)
+        ds = Dataset.from_tensor_slices((x, y)).batch(32)
+        with pytest.raises(ValueError, match="sparse integer labels"):
+            m.fit(ds, epochs=1, steps_per_epoch=1, verbose=0,
+                  class_weight={0: 2.0})
+
+    def test_changing_weights_rebuilds_step(self, eight_devices):
+        m = _model()
+        ds = _ds()
+        m.fit(ds, epochs=1, steps_per_epoch=2, verbose=0,
+              class_weight={0: 2.0})
+        t = m._trainer
+        step_a = t._train_step
+        m.fit(ds, epochs=1, steps_per_epoch=2, verbose=0,
+              class_weight={0: 3.0})
+        assert t._train_step is not step_a
+        m.fit(ds, epochs=1, steps_per_epoch=2, verbose=0,
+              class_weight={0: 3.0})  # unchanged -> cached
+        with pytest.raises(ValueError, match="negative class"):
+            m.fit(ds, epochs=1, steps_per_epoch=1, verbose=0,
+                  class_weight={-1: 2.0})
+
+
 class TestValidation:
     def test_val_logs_reported_each_epoch(self, eight_devices):
         s = td.MirroredStrategy()

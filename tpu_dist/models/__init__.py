@@ -17,6 +17,14 @@ from tpu_dist.models.layers import (
 )
 from tpu_dist.models.model import Model, Sequential
 from tpu_dist.models.serialize import load_model, save_model
+from tpu_dist.models.transformer import (
+    Embedding,
+    LayerNormalization,
+    MultiHeadAttention,
+    PositionalEmbedding,
+    TransformerBlock,
+    build_transformer_lm,
+)
 from tpu_dist.models.cnn import build_and_compile_cnn_model, build_cnn_model
 from tpu_dist.models.policy import compute_dtype, policy, set_policy
 from tpu_dist.models.resnet import ResNet18, ResNet50
@@ -38,6 +46,12 @@ __all__ = [
     "Model",
     "Sequential",
     "load_model",
+    "Embedding",
+    "LayerNormalization",
+    "MultiHeadAttention",
+    "PositionalEmbedding",
+    "TransformerBlock",
+    "build_transformer_lm",
     "save_model",
     "ResNet18",
     "ResNet50",

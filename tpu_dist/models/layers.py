@@ -208,12 +208,15 @@ class Dense(Layer):
     kernel_initializer: str = "glorot_uniform"
 
     def init(self, key, in_shape):
-        (din,) = in_shape
+        # Applies to the LAST axis (Keras Dense semantics): a (L, D) input
+        # (transformer token stream) maps to (L, units), a (D,) input to
+        # (units,).
+        din = in_shape[-1]
         params = {"kernel": initializers.get(self.kernel_initializer)(
             key, (din, self.units))}
         if self.use_bias:
             params["bias"] = jnp.zeros((self.units,), jnp.float32)
-        return params, {}, (self.units,)
+        return params, {}, (*in_shape[:-1], self.units)
 
     def apply(self, params, state, x, *, training=False, rng=None):
         y = x @ params["kernel"].astype(x.dtype)

@@ -111,7 +111,8 @@ class Model:
             verbose: int = 1, callbacks: Sequence = (), initial_epoch: int = 0,
             seed: int = 0, profile_dir: Optional[str] = None,
             validation_data=None, validation_steps: Optional[int] = None,
-            checkpoint_dir: Optional[str] = None):
+            checkpoint_dir: Optional[str] = None,
+            class_weight: Optional[dict] = None):
         """Run the epoch/step loop (tf_dist_example.py:59 surface).
 
         ``profile_dir`` captures a chief-only jax.profiler trace (SURVEY.md
@@ -119,7 +120,9 @@ class Model:
         reported as ``val_``-prefixed logs. ``checkpoint_dir`` enables
         chief-only per-epoch checkpointing AND resume-from-latest (SURVEY.md
         §5.4): if the directory already holds checkpoints, training continues
-        from the epoch after the newest one."""
+        from the epoch after the newest one. ``class_weight`` scales each
+        sample's loss contribution by its class's weight (Keras semantics
+        for imbalanced data; the weight table compiles into the step)."""
         from tpu_dist.training.trainer import Trainer
 
         if self.loss is None or self.optimizer is None:
@@ -134,7 +137,8 @@ class Model:
             seed=seed, profile_dir=profile_dir,
             validation_data=validation_data,
             validation_steps=validation_steps,
-            checkpoint_dir=checkpoint_dir)
+            checkpoint_dir=checkpoint_dir,
+            class_weight=class_weight)
 
     def evaluate(self, x, steps: Optional[int] = None, verbose: int = 1):
         from tpu_dist.training.trainer import Trainer

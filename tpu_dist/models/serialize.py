@@ -58,8 +58,10 @@ def layer_config(layer) -> dict:
 
 def layer_from_config(spec: dict):
     from tpu_dist.models import layers as layers_mod
+    from tpu_dist.models import transformer as transformer_mod
 
-    cls = getattr(layers_mod, spec["class"], None)
+    cls = getattr(layers_mod, spec["class"],
+                  getattr(transformer_mod, spec["class"], None))
     if cls is None or not isinstance(cls, type):
         raise ValueError(f"unknown layer class {spec['class']!r}")
     kwargs = {k: _decode_value(v) for k, v in spec["config"].items()}

@@ -1,0 +1,223 @@
+"""Transformer layers: the long-context model family.
+
+Beyond the reference's parity scope (its model zoo is a 2-conv CNN +
+benchmark ResNets, SURVEY.md R5/§2.3) — this family exists so the
+sequence-parallel axis (tpu_dist.parallel.sequence) has a first-class model
+to drive: :class:`MultiHeadAttention` takes a pluggable ``attention_fn``, so
+the same block runs dense softmax attention on one device or EXACT ring
+attention over a ``seq`` mesh axis for contexts that don't fit one device:
+
+    from functools import partial
+    from tpu_dist.parallel import make_mesh, ring_attention
+
+    mesh = make_mesh({"data": 2, "seq": 4})
+    attn = partial(ring_attention, mesh=mesh, axis_name="seq",
+                   causal=True, batch_axis="data")
+    block = TransformerBlock(num_heads=8, key_dim=64, ff_dim=2048,
+                             attention_fn=attn)
+
+All layers follow the pure-functional Layer protocol (layers.py): immutable
+dataclass descriptions, params/state pytrees owned by the caller, everything
+jit-traceable. TPU notes: attention and MLP matmuls are MXU-shaped; under
+``set_policy("mixed_bfloat16")`` activations run bf16 with fp32 params and
+LayerNorm statistics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.models.layers import Block, Dense, Layer, Residual
+from tpu_dist.ops import initializers
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class Embedding(Layer):
+    """Token embedding: int [L] -> float [L, dim] lookup table."""
+
+    vocab_size: int
+    dim: int
+    #: GPT-style init scale (normal); Keras' uniform(-0.05, 0.05) converges
+    #: slower at transformer depth.
+    init_scale: float = 0.02
+
+    def init(self, key, in_shape):
+        table = self.init_scale * jax.random.normal(
+            key, (self.vocab_size, self.dim), jnp.float32)
+        return {"table": table}, {}, (*in_shape, self.dim)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        from tpu_dist.models.policy import compute_dtype
+
+        return params["table"].astype(compute_dtype())[x], state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class PositionalEmbedding(Layer):
+    """Learned absolute positions, added to a [.., L, D] stream."""
+
+    max_len: int
+    init_scale: float = 0.02
+
+    def init(self, key, in_shape):
+        ln, d = in_shape[-2], in_shape[-1]
+        if ln > self.max_len:
+            raise ValueError(
+                f"sequence length {ln} exceeds max_len {self.max_len}")
+        table = self.init_scale * jax.random.normal(
+            key, (self.max_len, d), jnp.float32)
+        return {"table": table}, {}, in_shape
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        ln = x.shape[-2]
+        return x + params["table"][:ln].astype(x.dtype), state
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class LayerNormalization(Layer):
+    """LayerNorm over the last axis; statistics in float32 always."""
+
+    epsilon: float = 1e-5
+
+    def init(self, key, in_shape):
+        d = in_shape[-1]
+        return ({"gamma": jnp.ones((d,), jnp.float32),
+                 "beta": jnp.zeros((d,), jnp.float32)}, {}, in_shape)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axis=-1, keepdims=True)
+        var = xf.var(axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.epsilon)
+        y = y * params["gamma"] + params["beta"]
+        return y.astype(x.dtype), state
+
+
+def _dense_attention(q, k, v, *, causal: bool, scale: float):
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        ln = q.shape[-2]
+        mask = jnp.tril(jnp.ones((ln, ln), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@dataclasses.dataclass(frozen=True, repr=False)
+class MultiHeadAttention(Layer):
+    """Multi-head self-attention on a [.., L, D] stream.
+
+    ``attention_fn(q, k, v) -> out`` (shapes [B, H, L, key_dim]) swaps the
+    attention inner loop: default is dense softmax (``causal`` applies the
+    autoregressive mask); pass ``functools.partial(ring_attention, mesh=...,
+    axis_name='seq', causal=...)`` for sequence-parallel exact attention —
+    the projections stay identical, so the two paths are numerically
+    interchangeable (tests assert it). ``attention_fn`` models can't
+    full-model-serialize (a callable isn't JSON); save weights instead.
+    """
+
+    num_heads: int
+    key_dim: int
+    causal: bool = False
+    use_bias: bool = True
+    kernel_initializer: str = "glorot_uniform"
+    attention_fn: Optional[Callable] = None
+
+    def init(self, key, in_shape):
+        d = in_shape[-1]
+        h, dk = self.num_heads, self.key_dim
+        ks = jax.random.split(key, 4)
+        mk = initializers.get(self.kernel_initializer)
+        params = {
+            "wq": mk(ks[0], (d, h * dk)),
+            "wk": mk(ks[1], (d, h * dk)),
+            "wv": mk(ks[2], (d, h * dk)),
+            "wo": mk(ks[3], (h * dk, d)),
+        }
+        if self.use_bias:
+            z = lambda n: jnp.zeros((n,), jnp.float32)
+            params.update(bq=z(h * dk), bk=z(h * dk), bv=z(h * dk), bo=z(d))
+        return params, {}, in_shape
+
+    def _heads(self, x, w, b):
+        y = x @ w.astype(x.dtype)
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        *lead, ln, _ = y.shape
+        y = y.reshape(*lead, ln, self.num_heads, self.key_dim)
+        return jnp.moveaxis(y, -2, -3)  # [.., H, L, dk]
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        b = (lambda n: params[n]) if self.use_bias else (lambda n: None)
+        q = self._heads(x, params["wq"], b("bq"))
+        k = self._heads(x, params["wk"], b("bk"))
+        v = self._heads(x, params["wv"], b("bv"))
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v)
+        else:
+            out = _dense_attention(q, k, v, causal=self.causal,
+                                   scale=1.0 / math.sqrt(self.key_dim))
+        out = jnp.moveaxis(out, -3, -2)  # [.., L, H, dk]
+        *lead, ln, h, dk = out.shape
+        out = out.reshape(*lead, ln, h * dk)
+        y = out @ params["wo"].astype(out.dtype)
+        if self.use_bias:
+            y = y + params["bo"].astype(y.dtype)
+        return y, state
+
+
+def TransformerBlock(d_model: int, num_heads: int, ff_dim: int,
+                     key_dim: Optional[int] = None, causal: bool = False,
+                     activation: str = "gelu",
+                     attention_fn: Optional[Callable] = None,
+                     epsilon: float = 1e-5) -> Block:
+    """Pre-LN transformer block: x + MHA(LN(x)), then x + MLP(LN(x)) —
+    built from the existing Residual container (identity shortcut), so
+    params nest exactly like the ResNet blocks. ``d_model`` is the residual
+    stream width (the MLP projects ff_dim back to it); ``key_dim`` defaults
+    to d_model / num_heads."""
+    if key_dim is None:
+        if d_model % num_heads:
+            raise ValueError(
+                f"d_model {d_model} not divisible by num_heads {num_heads}; "
+                "pass key_dim explicitly")
+        key_dim = d_model // num_heads
+    attn = Residual(
+        main=(LayerNormalization(epsilon=epsilon),
+              MultiHeadAttention(num_heads=num_heads, key_dim=key_dim,
+                                 causal=causal, attention_fn=attention_fn)),
+        shortcut=(), activation=None)
+    mlp = Residual(
+        main=(LayerNormalization(epsilon=epsilon),
+              Dense(ff_dim, activation=activation),
+              Dense(d_model)),
+        shortcut=(), activation=None)
+    return Block(layers=(attn, mlp))
+
+
+def build_transformer_lm(vocab_size: int, seq_len: int, *, d_model: int = 128,
+                         depth: int = 2, num_heads: int = 4,
+                         ff_dim: Optional[int] = None,
+                         attention_fn: Optional[Callable] = None):
+    """A small causal (GPT-style) language model: token + position
+    embeddings, ``depth`` pre-LN blocks, final LN, vocab head. Inputs are
+    int token ids [B, L]; outputs are logits [B, L, vocab]."""
+    from tpu_dist.models.model import Sequential
+
+    ff_dim = ff_dim or 4 * d_model
+    layers = [Embedding(vocab_size, d_model),
+              PositionalEmbedding(max_len=seq_len)]
+    for _ in range(depth):
+        layers.append(TransformerBlock(
+            d_model, num_heads, ff_dim, causal=True,
+            attention_fn=attention_fn))
+    layers += [LayerNormalization(), Dense(vocab_size)]
+    return Sequential(layers, input_shape=(seq_len,),
+                      name="transformer_lm")

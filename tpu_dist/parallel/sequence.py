@@ -124,8 +124,13 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int,
                        varying_axes)
     l0 = _mark_varying(jnp.zeros((b, h, lc), jnp.float32), varying_axes)
     acc0 = _mark_varying(jnp.zeros((b, h, lc, d), jnp.float32), varying_axes)
+    # Rematerialize each ring step on the backward pass: without this, grad
+    # saves every step's [Lc, Lc] score block (O(L^2/P) memory — exactly
+    # what ring attention exists to avoid); with it, backward memory is
+    # O(L/P) and the scores are recomputed per step (the flash-attention
+    # trade, cheap next to the ppermute ring).
     (m, l, acc, _, _), _ = jax.lax.scan(
-        step, (m0, l0, acc0, k, v), jnp.arange(axis_size))
+        jax.checkpoint(step), (m0, l0, acc0, k, v), jnp.arange(axis_size))
 
     # Fully-masked rows (can't happen for self-attention with causal=True,
     # since position i always attends to itself) would give l == 0; guard

@@ -86,10 +86,22 @@ class _Scope:
 
 
 class Strategy:
-    """Base: a named device mesh + pure-data-parallel sharding policy."""
+    """Base: a named device mesh + pure-data-parallel sharding policy.
 
-    def __init__(self, devices: Sequence | None = None, *, local: bool = False):
-        self._mesh = mesh_lib.make_mesh(devices=devices, local=local)
+    ``axis_shapes`` opens extra mesh axes next to ``data`` (e.g.
+    ``{"data": 2, "seq": 4}`` for combined data x sequence parallelism —
+    batches shard over ``data`` exactly as before, and the extra axes are
+    available to ``ring_attention``/``shard_map`` inside the model)."""
+
+    def __init__(self, devices: Sequence | None = None, *,
+                 local: bool = False,
+                 axis_shapes: Optional[dict] = None):
+        if axis_shapes is not None and mesh_lib.DATA_AXIS not in axis_shapes:
+            raise ValueError(
+                f"axis_shapes must include the {mesh_lib.DATA_AXIS!r} axis "
+                f"(batches shard over it), got {axis_shapes}")
+        self._mesh = mesh_lib.make_mesh(axis_shapes, devices=devices,
+                                        local=local)
 
     # -- core surface --------------------------------------------------------
 
@@ -103,9 +115,12 @@ class Strategy:
 
     @property
     def num_replicas_in_sync(self) -> int:
-        """Global replica count — TF's ``strategy.num_replicas_in_sync``
-        (verified == 2 in the reference's 2-worker run, SURVEY.md §3.5)."""
-        return self._mesh.devices.size
+        """Data-parallel replica count — TF's ``strategy.num_replicas_in_sync``
+        (verified == 2 in the reference's 2-worker run, SURVEY.md §3.5).
+        With extra mesh axes (axis_shapes) this is the ``data`` axis size,
+        not the device count: a data(2) x seq(4) mesh runs 2 replicas."""
+        return self._mesh.shape.get(mesh_lib.DATA_AXIS,
+                                    self._mesh.devices.size)
 
     def scope(self) -> _Scope:
         """Context manager pinning this strategy as current
@@ -364,10 +379,12 @@ class MirroredStrategy(Strategy):
     mesh is built from whatever devices exist.
     """
 
-    def __init__(self, devices: Sequence | None = None):
-        super().__init__(devices=devices, local=devices is None)
-        logger.info("MirroredStrategy over %d device(s): %s",
-                    self.num_replicas_in_sync,
+    def __init__(self, devices: Sequence | None = None,
+                 axis_shapes: Optional[dict] = None):
+        super().__init__(devices=devices, local=devices is None,
+                         axis_shapes=axis_shapes)
+        logger.info("MirroredStrategy: %d replica(s) on mesh %s: %s",
+                    self.num_replicas_in_sync, dict(self._mesh.shape),
                     [str(d) for d in self._mesh.devices.flat])
 
 

@@ -76,6 +76,7 @@ class Trainer:
         self._built_policy: Optional[str] = None
         self._metric_init_fn = None
         self._loss_acc_init_fn = None
+        self._class_weight: Optional[dict] = None
 
     def _maybe_invalidate_for_policy(self) -> None:
         """Drop cached compiled steps when the global mixed-precision policy
@@ -165,10 +166,34 @@ class Trainer:
                                       self.model.optimizer)
         metrics = tuple(model.metrics)
 
+        import jax.numpy as jnp
+
+        class_weight = self._class_weight
+
         def step(params, state, opt_state, metric_states, loss_acc, x, y, rng):
             def loss_fn(p):
                 logits, new_state = model.apply(p, state, x, training=True,
                                                 rng=rng)
+                if class_weight is not None:
+                    # Keras class_weight semantics: scale each sample's loss
+                    # contribution by its class's weight (default 1.0)
+                    # before the batch-size mean. Built with per-class
+                    # where() — an index table would CLAMP labels outside
+                    # its range under jit, silently mis-weighting them.
+                    if not jnp.issubdtype(y.dtype, jnp.integer):
+                        raise ValueError(
+                            "class_weight requires sparse integer labels; "
+                            f"got labels of dtype {y.dtype}")
+                    per = loss_obj.per_example(logits, y)
+                    if per.shape != y.shape:
+                        raise ValueError(
+                            "class_weight requires per-example labels "
+                            f"matching the loss (labels {y.shape} vs "
+                            f"per-example loss {per.shape})")
+                    w = jnp.ones_like(per)
+                    for c, wt in class_weight.items():
+                        w = jnp.where(y == c, jnp.float32(wt), w)
+                    return (per * w).mean(), (logits, new_state)
                 return loss_obj(logits, y), (logits, new_state)
 
             (loss, (logits, new_state)), grads = jax.value_and_grad(
@@ -340,9 +365,22 @@ class Trainer:
             verbose: int, callbacks: Sequence, initial_epoch: int,
             seed: int, profile_dir: Optional[str] = None,
             validation_data=None, validation_steps: Optional[int] = None,
-            checkpoint_dir: Optional[str] = None) -> History:
+            checkpoint_dir: Optional[str] = None,
+            class_weight: Optional[dict] = None) -> History:
         self.ensure_variables(seed)
         self._maybe_invalidate_for_policy()
+        if class_weight is not None:
+            class_weight = {int(c): float(w) for c, w in class_weight.items()}
+            if any(c < 0 for c in class_weight):
+                raise ValueError(f"negative class index in {class_weight}")
+            if not class_weight:  # {} means no weighting, like None
+                class_weight = None
+        if class_weight != self._class_weight:
+            # The weight table is baked into the compiled step; a different
+            # weighting needs a rebuild (weights carry over untouched).
+            self._class_weight = class_weight
+            self._train_step = None
+            self._multi_step = None
         if self._train_step is None:
             self._train_step = self._build_train_step()
         if (getattr(self.model, "steps_per_execution", 1) > 1
