@@ -53,11 +53,18 @@ REFERENCE_CPU_IMG_PER_SEC_PER_CORE = 128 / 0.062
 PEAK_FLOPS_TPU = float(os.environ.get("TPU_DIST_PEAK_FLOPS", 197e12))
 
 CONFIGS = {
-    # name: (dataset, model builder name, image shape, default global batch)
+    # name: (dataset, model builder name, input shape, default global batch)
     "mnist_cnn": ("mnist", "cnn", (28, 28, 1), 128),
     "resnet18": ("fashion_mnist", "resnet18", (28, 28, 1), 256),
     "resnet50": ("cifar10", "resnet50", (32, 32, 3), 256),
+    # Long-context family: GPT-style causal LM, seq len 512, synthetic
+    # tokens ("shape" = (seq_len,) of int ids, not pixels).
+    "transformer_lm": ("synthetic_tokens", "transformer_lm", (512,), 64),
 }
+
+#: transformer_lm model hyperparameters (GPT-small-ish layer dims so the
+#: attention/MLP matmuls are MXU-shaped).
+TRANSFORMER_LM = dict(vocab_size=8192, d_model=512, depth=4, num_heads=8)
 
 
 def build_model(kind: str, input_shape, num_classes: int = 10,
@@ -71,6 +78,14 @@ def build_model(kind: str, input_shape, num_classes: int = 10,
 
         model = build_cnn_model(num_classes=num_classes,
                                 input_shape=input_shape)
+    elif kind == "transformer_lm":
+        from tpu_dist.models.transformer import build_transformer_lm
+
+        model = build_transformer_lm(
+            TRANSFORMER_LM["vocab_size"], input_shape[0],
+            d_model=TRANSFORMER_LM["d_model"],
+            depth=TRANSFORMER_LM["depth"],
+            num_heads=TRANSFORMER_LM["num_heads"])
     else:
         from tpu_dist.models import resnet
 
@@ -91,6 +106,16 @@ def load_batch(dataset_name: str, shape, global_batch: int):
     the deterministic synthetic fallback — tpu_dist.data.sources)."""
     from tpu_dist.data.sources import load_arrays
 
+    if dataset_name == "synthetic_tokens":
+        # Next-token LM batch: deterministic id stream, targets = inputs
+        # shifted by one.
+        ln = shape[0]
+        vocab = TRANSFORMER_LM["vocab_size"]
+        stream = (np.arange(global_batch * ln + 1) * 2654435761) % vocab
+        x = stream[:-1].reshape(global_batch, ln).astype(np.int64)
+        y = stream[1:].reshape(global_batch, ln).astype(np.int64)
+        return x, y
+
     x_all, y_all = load_arrays(dataset_name, "train")
     reps = -(-global_batch // len(x_all))
     if reps > 1:
@@ -101,7 +126,8 @@ def load_batch(dataset_name: str, shape, global_batch: int):
     return x, y
 
 
-def _flops_per_step(model, strategy, shape, global_batch) -> float | None:
+def _flops_per_step(model, strategy, shape, global_batch,
+                    token_model: bool = False) -> float | None:
     """XLA's own FLOP estimate for ONE train step (fwd+bwd+update).
 
     Always measured on the single-step program: XLA's cost model counts a
@@ -113,8 +139,12 @@ def _flops_per_step(model, strategy, shape, global_batch) -> float | None:
     try:
         fn = model.make_train_function(steps_per_execution=1)
         state = model.train_state()
-        x = np.zeros((global_batch, *shape), np.float32)
-        y = np.zeros((global_batch,), np.int64)
+        if token_model:  # int ids in, per-position labels out
+            x = np.zeros((global_batch, *shape), np.int64)
+            y = np.zeros((global_batch, *shape), np.int64)
+        else:
+            x = np.zeros((global_batch, *shape), np.float32)
+            y = np.zeros((global_batch,), np.int64)
         xb = strategy.distribute_batch(x)
         yb = strategy.distribute_batch(y)
         cost = fn.lower(*state, xb, yb,
@@ -177,8 +207,9 @@ def _run_step_bench_body(config, dataset_name, kind, shape, global_batch,
         warmup = -(-warmup // spe) * spe
         x, y = load_batch(dataset_name, shape, global_batch * spe)
         xb = strategy.distribute_batch_stack(
-            x.reshape(spe, global_batch, *shape))
-        yb = strategy.distribute_batch_stack(y.reshape(spe, global_batch))
+            x.reshape(spe, global_batch, *x.shape[1:]))
+        yb = strategy.distribute_batch_stack(
+            y.reshape(spe, global_batch, *y.shape[1:]))
         keys = [jnp_stack_keys(key, i * spe, spe)
                 for i in range((warmup + steps) // spe)]
         n_exec_warm, n_exec = warmup // spe, steps // spe
@@ -234,7 +265,12 @@ def _run_step_bench_body(config, dataset_name, kind, shape, global_batch,
         "final_loss": float(jax.device_get(loss)),
         "precision_policy": get_policy(),
     }
-    flops_step = _flops_per_step(model, strategy, shape, global_batch)
+    if dataset_name == "synthetic_tokens":
+        # "images" are sequences here; tokens/sec is the LM-native unit.
+        result["tokens_per_sec_per_core"] = round(
+            img_per_sec * shape[0] / n_dev, 1)
+    flops_step = _flops_per_step(model, strategy, shape, global_batch,
+                                 token_model=dataset_name == "synthetic_tokens")
     if flops_step is not None:
         flops_per_sec = flops_step / (elapsed / steps)
         result["tflops_per_sec_per_core"] = round(
@@ -499,6 +535,13 @@ def driver_run() -> int:
             precision_policy="mixed_bfloat16"),
         "resnet50_bf16": lambda: run_step_bench(
             "resnet50", steps=48, warmup=8, global_batch=256, spe=4,
+            precision_policy="mixed_bfloat16"),
+        # Long-context family: GPT-style causal LM (vocab 8k, d_model 512,
+        # 4 blocks, seq 512) — the attention/MLP matmul workload.
+        "transformer_lm": lambda: run_step_bench(
+            "transformer_lm", steps=32, warmup=8, global_batch=64, spe=8),
+        "transformer_lm_bf16": lambda: run_step_bench(
+            "transformer_lm", steps=32, warmup=8, global_batch=64, spe=8,
             precision_policy="mixed_bfloat16"),
         "cpu_baseline": run_cpu_baseline,
     }

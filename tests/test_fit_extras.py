@@ -97,6 +97,18 @@ class TestClassWeight:
                      class_weight={})
         assert np.isfinite(hist.history["loss"][0])
 
+    def test_make_train_function_is_unweighted(self, eight_devices):
+        # The public compiled-step surface must not silently inherit a
+        # prior fit's class weights (benchmarks would report weighted loss).
+        m = _model(lr=0.0)
+        ds = _ds(n=64, batch=32)
+        m.fit(ds, epochs=1, steps_per_epoch=1, verbose=0,
+              class_weight={0: 100.0})
+        t = m._trainer
+        assert t._class_weight is not None
+        m.make_train_function(steps_per_execution=1)
+        assert t._class_weight is None
+
     def test_class_weight_rejects_onehot_labels(self, eight_devices):
         from tpu_dist.ops import CategoricalCrossentropy
 

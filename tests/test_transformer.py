@@ -157,6 +157,17 @@ class TestTransformerLM:
         with pytest.raises(ValueError, match="must include"):
             td.MirroredStrategy(axis_shapes={"seq": 8})
 
+    def test_attention_fn_model_save_raises_actionably(self, eight_devices,
+                                                       tmp_path):
+        attn = functools.partial(ring_attention, mesh=make_mesh({"seq": 8}),
+                                 axis_name="seq", causal=True)
+        model = build_transformer_lm(7, 8, d_model=16, depth=1, num_heads=2,
+                                     attention_fn=attn)
+        from tpu_dist.models.serialize import save_model
+
+        with pytest.raises(TypeError, match="save_weights"):
+            save_model(model, tmp_path / "lm")
+
     def test_lm_roundtrips_save_load(self, eight_devices, tmp_path):
         model = build_transformer_lm(7, 6, d_model=16, depth=1, num_heads=2)
         model.compile(loss=td.ops.SparseCategoricalCrossentropy(

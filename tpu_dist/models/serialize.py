@@ -33,6 +33,13 @@ def _encode_value(v):
         return {"__layer__": layer_config(v)}
     if isinstance(v, (tuple, list)):
         return [_encode_value(e) for e in v]
+    if callable(v):
+        # e.g. MultiHeadAttention.attention_fn=partial(ring_attention, ...)
+        raise TypeError(
+            f"cannot serialize layer field holding a callable ({v!r}); "
+            "models with runtime hooks (e.g. a ring attention_fn) can't "
+            "full-model save — use save_weights()/load_weights and rebuild "
+            "the architecture in code")
     return v
 
 
@@ -175,10 +182,14 @@ def save_model(model, directory) -> None:
     if model._trainer is None:
         model._trainer = Trainer(model)
     model._trainer.ensure_variables()
+    # Encode on EVERY process (not just the chief): an unserializable layer
+    # field (e.g. a ring attention_fn) must raise everywhere, or non-chief
+    # processes would block at the checkpoint barrier below.
+    encoded = json.dumps(model_config(model), indent=2)
     if bootstrap.is_chief():
         directory.mkdir(parents=True, exist_ok=True)
         tmp = directory / f".{CONFIG_NAME}.tmp.{os.getpid()}"
-        tmp.write_text(json.dumps(model_config(model), indent=2))
+        tmp.write_text(encoded)
         os.replace(tmp, directory / CONFIG_NAME)
     checkpoint.save(directory, model, step=0)
 

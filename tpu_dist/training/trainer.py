@@ -275,9 +275,19 @@ class Trainer:
         carry a leading K axis (stack K batches; see ``jnp_stack_keys``) and
         whose loss is the K-mean. Both donate their variable arguments —
         callers must thread the returned state into the next call.
+
+        Always the UNWEIGHTED loss: if a prior ``fit(class_weight=...)``
+        baked weights into the cached step, the step is rebuilt without
+        them (weighted training is a fit-loop feature; a benchmark or
+        custom loop asking for "the train step" must not inherit it
+        silently).
         """
         self.ensure_variables()
         self._maybe_invalidate_for_policy()
+        if self._class_weight is not None:
+            self._class_weight = None
+            self._train_step = None
+            self._multi_step = None
         k = (steps_per_execution if steps_per_execution is not None
              else max(1, int(getattr(self.model, "steps_per_execution", 1))))
         if k > 1:
