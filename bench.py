@@ -345,11 +345,13 @@ def run_e2e_fit(config: str, epochs: int, steps_per_epoch: int,
 
 
 def _child_env(n_devices: int) -> dict:
+    # Same flag surgery as the driver entrypoint's virtual-mesh re-exec —
+    # one implementation, two child-spawn paths.
+    from __graft_entry__ import _force_device_count_flags
+
     env = dict(os.environ)
-    flags = " ".join(f for f in env.get("XLA_FLAGS", "").split()
-                     if "xla_force_host_platform_device_count" not in f)
-    env["XLA_FLAGS"] = (
-        f"{flags} --xla_force_host_platform_device_count={n_devices}").strip()
+    env["XLA_FLAGS"] = _force_device_count_flags(
+        env.get("XLA_FLAGS", ""), n_devices)
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""  # disarm the TPU sitecustomize
     return env
@@ -394,11 +396,25 @@ def measure_tf_reference(timeout: float = 1500) -> dict | None:
         tf_version = importlib.metadata.version("tensorflow")
     except importlib.metadata.PackageNotFoundError:
         tf_version = None
+
+    def _machine_unique():
+        # Same-image VMs share hostname/kernel/cpu_count; machine-id (or
+        # per-boot boot_id) actually distinguishes machines, at the cost of
+        # one fresh ~minute measurement per machine/boot.
+        for p in ("/etc/machine-id", "/proc/sys/kernel/random/boot_id"):
+            try:
+                with open(p) as f:
+                    return f.read().strip()
+            except OSError:
+                continue
+        return None
+
     fingerprint = {"hostname": socket.gethostname(),
                    "machine": platform.machine(),
                    "cpu_count": os.cpu_count(),
                    "kernel": platform.release(),
-                   "tf_version": tf_version}
+                   "tf_version": tf_version,
+                   "machine_id": _machine_unique()}
     try:
         with open(TF_BASELINE_CACHE) as f:
             cached = json.load(f)

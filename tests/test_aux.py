@@ -81,3 +81,33 @@ class TestLivenessSingleProcess:
         assert m._thread is None  # single-process: nothing to monitor
         m.raise_if_failed()  # must not raise
         m.stop()
+
+
+class TestProberRecovery:
+    def test_wedged_worker_replaced_and_recovers(self):
+        # A probe fn that hangs forever wedges the worker; the NEXT probe
+        # must get a fresh worker (fresh RPC) and succeed — bounded by the
+        # MAX_WEDGED_WORKERS backstop.
+        import threading
+
+        from tpu_dist.cluster.liveness import _Prober
+
+        p = _Prober()
+        hang_forever = threading.Event()
+
+        out = p.probe(lambda: (hang_forever.wait(60), "late")[1],
+                      timeout_s=0.05)
+        assert isinstance(out, TimeoutError)
+        # Recovery: a healthy fn must succeed on a replacement worker even
+        # though the first worker is still blocked.
+        assert p.probe(lambda: "healthy", timeout_s=5.0) == "healthy"
+        assert p._wedged_count == 1
+        # Backstop: after the cap, fail fast without new threads.
+        p._wedged_count = p.MAX_WEDGED_WORKERS
+        out = p.probe(lambda: (hang_forever.wait(60), "late")[1],
+                      timeout_s=0.05)
+        assert isinstance(out, TimeoutError)
+        out = p.probe(lambda: "never-run", timeout_s=0.5)
+        assert isinstance(out, TimeoutError)
+        assert "not spawning more" in str(out)
+        hang_forever.set()  # release the stuck daemon threads

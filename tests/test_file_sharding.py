@@ -113,6 +113,26 @@ class TestFileShard:
         with pytest.raises(ValueError, match="evenly"):
             shard_dataset(ds, 3, 0, AutoShardPolicy.FILE)
 
+    def test_unequal_per_file_counts_refuse_file_policy(self, tmp_path):
+        # 4 files over 2 workers passes the COUNT check, but element totals
+        # [100+50 vs 50+50] would desync sync-SPMD training: FILE must
+        # refuse and AUTO must fall back to DATA.
+        for i, n in enumerate((100, 50, 50, 50)):
+            np.save(tmp_path / f"u{i}.npy", np.arange(n))
+        files = sorted(tmp_path.glob("u*.npy"))
+        ds = Dataset.from_files(files, lambda p: iter(np.load(p)),
+                                file_cardinalities=[100, 50, 50, 50])
+        assert resolve_policy(ds, 2, AutoShardPolicy.AUTO) == \
+            AutoShardPolicy.DATA
+        with pytest.raises(ValueError, match="evenly"):
+            shard_dataset(ds, 2, 0, AutoShardPolicy.FILE)
+        # Balanced totals (stride groups i::2 -> {0,2} and {1,3} equal)
+        # still qualify for FILE.
+        ds2 = Dataset.from_files(files, lambda p: iter(np.load(p)),
+                                 file_cardinalities=[100, 50, 50, 100])
+        assert resolve_policy(ds2, 2, AutoShardPolicy.AUTO) == \
+            AutoShardPolicy.FILE
+
     def test_stale_generation_not_mixed(self, shard_dir, tmp_path):
         # Re-sharding with a different count leaves the old generation on
         # disk; load must serve exactly ONE complete generation.

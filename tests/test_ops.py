@@ -83,6 +83,24 @@ class TestExtendedLosses:
                                         jnp.array([[0.0], [0.0]])))
         np.testing.assert_allclose(val, 1.5, rtol=1e-6)
 
+    def test_regression_losses_align_single_output_head(self):
+        # [B] targets vs a Dense(1) head's [B, 1] preds must align, never
+        # silently broadcast to [B, B] (same guard as the binary losses).
+        from tpu_dist.ops.losses import (Huber, MeanAbsoluteError,
+                                         MeanSquaredError)
+
+        preds = jnp.array([[1.0], [3.0]])
+        targets = jnp.array([0.0, 0.0])
+        np.testing.assert_allclose(
+            float(MeanSquaredError()(preds, targets)), 5.0, rtol=1e-6)
+        np.testing.assert_allclose(
+            float(MeanAbsoluteError()(preds, targets)), 2.0, rtol=1e-6)
+        # Huber delta 1: 0.5*1 + 1*(1-0.5)=0.5 for |1|; 1*(3-0.5)=2.5 for |3|
+        np.testing.assert_allclose(
+            float(Huber(delta=1.0)(preds, targets)), 1.5, rtol=1e-6)
+        with pytest.raises(ValueError, match="disagree"):
+            MeanSquaredError()(jnp.zeros((3, 2)), jnp.zeros((4, 2)))
+
     def test_new_string_identifiers(self):
         for name in ("mae", "binary_crossentropy", "huber"):
             assert losses.get(name) is not None

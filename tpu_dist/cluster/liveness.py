@@ -53,11 +53,17 @@ class _Prober:
 
     ``get_live_nodes`` has no RPC deadline of its own, so a partitioned
     (reachable-but-unresponsive) coordinator can hang a probe indefinitely.
-    Running every probe on a single persistent worker bounds the damage to ONE
-    blocked thread per process, however long the coordinator stays wedged —
-    new attempts simply queue behind the hung call and time out in turn,
-    instead of each abandoning a fresh thread.
+    Running probes on a persistent worker bounds the damage: a hung call
+    wedges one thread, later attempts queue and time out in turn. When a
+    probe TIMES OUT mid-call the worker is considered wedged and the next
+    probe starts a FRESH worker (with a fresh RPC) so liveness can recover
+    once the coordinator heals — capped at ``MAX_WEDGED_WORKERS`` abandoned
+    threads per process, after which probes fail fast without spawning more
+    (permanent-coordinator-death backstop; the r1-advice unbounded-thread
+    leak stays fixed).
     """
+
+    MAX_WEDGED_WORKERS = 4
 
     def __init__(self):
         import queue
@@ -69,10 +75,13 @@ class _Prober:
         self._abandoned: set = set()
         self._seq = 0
         self._thread: Optional[threading.Thread] = None
+        self._in_flight: Optional[int] = None  # seq the worker is running
+        self._wedged_count = 0
+        self._gen = 0  # worker generation; replaced workers stop touching state
 
-    def _run(self) -> None:
+    def _run(self, generation_queue, gen: int) -> None:
         while True:
-            seq, fn = self._requests.get()
+            seq, fn = generation_queue.get()
             with self._cv:
                 if seq in self._abandoned:
                     # Caller timed out while this request was still queued
@@ -80,11 +89,17 @@ class _Prober:
                     # so a backlog never delays the first fresh probe.
                     self._abandoned.discard(seq)
                     continue
+                if self._gen == gen:
+                    self._in_flight = seq
             try:
                 out = fn()
             except Exception as e:  # returned to the caller as the result
                 out = e
             with self._cv:
+                # A replaced (wedged) worker that eventually finishes must
+                # not clobber the live generation's bookkeeping.
+                if self._gen == gen:
+                    self._in_flight = None
                 if seq in self._abandoned:
                     self._abandoned.discard(seq)  # caller gave up mid-call
                 else:
@@ -94,16 +109,36 @@ class _Prober:
     def probe(self, fn, timeout_s: float):
         """Run ``fn()`` on the worker; returns its result/exception, or a
         TimeoutError if no answer arrives within ``timeout_s``."""
+        import queue
         import time
 
         with self._submit_lock:
+            with self._cv:
+                wedged = self._in_flight is not None and \
+                    self._in_flight in self._abandoned
+            if wedged:
+                if self._wedged_count >= self.MAX_WEDGED_WORKERS:
+                    return TimeoutError(
+                        f"coordination service unresponsive: "
+                        f"{self._wedged_count} probe workers wedged; "
+                        "not spawning more")
+                # Abandon the wedged worker (its queue goes with it) and
+                # start a fresh one so this probe issues a FRESH RPC.
+                self._wedged_count += 1
+                self._thread = None
+                self._requests = queue.Queue()
+                with self._cv:
+                    self._gen += 1
+                    self._in_flight = None
             if self._thread is None or not self._thread.is_alive():
                 self._thread = threading.Thread(
-                    target=self._run, daemon=True, name="tpu_dist_probe")
+                    target=self._run, args=(self._requests, self._gen),
+                    daemon=True, name="tpu_dist_probe")
                 self._thread.start()
             self._seq += 1
             seq = self._seq
-        self._requests.put((seq, fn))
+            requests = self._requests
+        requests.put((seq, fn))
         deadline = time.monotonic() + timeout_s
         with self._cv:
             while seq not in self._results:

@@ -188,6 +188,10 @@ class Dataset:
                 yield from reader(f)
 
         ds = Dataset(factory, cardinality=cardinality, num_files=len(files))
+        #: Per-file counts (when known) let the FILE-shard guard verify each
+        #: worker's strided subset carries the SAME total element count —
+        #: equal file counts alone don't guarantee equal streams.
+        ds._file_cardinalities = file_cardinalities
         # TF strides the file list across workers (worker i reads files
         # i, i+n, i+2n, ...); the subset source keeps its own file count and
         # (when per-file counts are known) its own cardinality.

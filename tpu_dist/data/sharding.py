@@ -54,8 +54,22 @@ def _files_divide_evenly(dataset: Dataset, num_shards: int) -> bool:
     """Synchronous SPMD needs every process in lockstep: an uneven file split
     gives workers streams of different lengths, desyncing the per-step global
     batch assembly. (TF tolerates unevenness because its per-worker iterators
-    are independent; our single-program model cannot.)"""
-    return dataset.num_files % num_shards == 0
+    are independent; our single-program model cannot.)
+
+    Checks the file COUNT divides evenly AND — when the source knows its
+    per-file element counts — that every worker's strided file subset sums
+    to the same element total (4 files over 2 workers with counts
+    [100, 50, 50, 50] would still desync despite 4 % 2 == 0)."""
+    if dataset.num_files % num_shards != 0:
+        return False
+    root = dataset
+    while root._parent is not None:
+        root = root._parent
+    counts = getattr(root, "_file_cardinalities", None)
+    if counts:
+        totals = {sum(counts[i::num_shards]) for i in range(num_shards)}
+        return len(totals) == 1
+    return True
 
 
 def resolve_policy(dataset: Dataset, num_shards: int,
@@ -119,10 +133,11 @@ def shard_dataset(dataset: Dataset, num_shards: int, index: int,
             # fail fast with the fix instead of hanging at a collective.
             raise ValueError(
                 f"AutoShardPolicy.FILE: {dataset.num_files} files do not "
-                f"divide evenly over {num_shards} workers; synchronous "
-                "training requires equal-length worker streams. Re-shard the "
-                "source (sources.write_sharded) to a multiple of the worker "
-                "count, or use DATA.")
+                f"divide evenly over {num_shards} workers (by file count or "
+                "by per-file element totals); synchronous training requires "
+                "equal-length worker streams. Re-shard the source "
+                "(sources.write_sharded) to a multiple of the worker count "
+                "with balanced shards, or use DATA.")
         return _file_shard(dataset, num_shards, index, rebatch=pre_batched)
 
     assert concrete == AutoShardPolicy.DATA

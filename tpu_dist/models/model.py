@@ -272,10 +272,23 @@ class Sequential(Model):
             shape = tuple(self.input_shape)
             total = total_state = 0
             for name, layer in zip(self.layer_names, self.layers):
-                params, state, shape = layer.init(key, shape)
-                n = count(params)
+                # eval_shape: shapes/counts WITHOUT materializing params
+                # (a real init would run every initializer and allocate the
+                # full model — tens of MB for the ResNets — per summary()).
+                # The out-shape is plain Python computed during tracing, so
+                # capture it; the abstracted pytrees carry the shapes.
+                captured = {}
+
+                def abstract_init(k, layer=layer, shape=shape):
+                    p, s, out = layer.init(k, shape)
+                    captured["out"] = out
+                    return p, s
+
+                p_spec, s_spec = jax.eval_shape(abstract_init, key)
+                shape = captured["out"]
+                n = count(p_spec)
                 total += n
-                total_state += count(state)
+                total_state += count(s_spec)
                 lines.append(f"{name:<26}{type(layer).__name__:<22}"
                              f"{str(tuple(shape)):<18}{n:>10,}")
             lines.append("-" * len(header))

@@ -13,7 +13,7 @@ attention over a ``seq`` mesh axis for contexts that don't fit one device:
     mesh = make_mesh({"data": 2, "seq": 4})
     attn = partial(ring_attention, mesh=mesh, axis_name="seq",
                    causal=True, batch_axis="data")
-    block = TransformerBlock(num_heads=8, key_dim=64, ff_dim=2048,
+    block = TransformerBlock(d_model=512, num_heads=8, ff_dim=2048,
                              attention_fn=attn)
 
 All layers follow the pure-functional Layer protocol (layers.py): immutable

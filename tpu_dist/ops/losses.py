@@ -33,10 +33,12 @@ def categorical_crossentropy(logits, onehot, *, from_logits: bool = True):
 
 
 def mean_squared_error(preds, targets):
+    targets = _align_binary_shapes(preds, jnp.asarray(targets))
     return jnp.mean(jnp.square(preds - targets), axis=-1)
 
 
 def mean_absolute_error(preds, targets):
+    targets = _align_binary_shapes(preds, jnp.asarray(targets))
     return jnp.mean(jnp.abs(preds - targets), axis=-1)
 
 
@@ -74,6 +76,7 @@ def binary_crossentropy(preds, targets, *, from_logits: bool = False):
 
 def huber(preds, targets, *, delta: float = 1.0):
     """Quadratic within ±delta, linear outside — tf.keras.losses.Huber."""
+    targets = _align_binary_shapes(preds, jnp.asarray(targets))
     err = preds - targets
     abs_err = jnp.abs(err)
     quad = jnp.minimum(abs_err, delta)
