@@ -23,8 +23,9 @@ and prints ONE JSON line on stdout:
      "unit": "images/sec/core", "vs_baseline": R, ...extras...}
 
 Other modes:
-    python bench.py [mnist_cnn|resnet18|resnet50] [--steps N] [--batch N]
-                    [--spe K] [--e2e]        # one config, report to stderr
+    python bench.py [mnist_cnn|resnet18|resnet50|transformer_lm]
+                    [--steps N] [--batch N] [--spe K] [--bf16] [--e2e]
+                                             # one config, report to stderr
     python bench.py --scaling                # 1/2/4/8-device virtual CPU mesh
                                              # fixed-global-work partition-
                                              # overhead table
