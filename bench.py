@@ -592,6 +592,25 @@ def driver_run() -> int:
         basis = cpu.get(
             "reference_basis",
             "2-device CPU e2e fit vs SURVEY.md §3.5 constant")
+    # The driver captures only the TAIL of stdout, so the one stdout JSON
+    # line must stay short (r2 inlined every extra and the capture started
+    # mid-JSON -> BENCH_r02 parsed=null). Headline scalars only here; the
+    # full record goes to benchmarks/bench_r3_full.json (path in the line).
+    extras_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "bench_r3_full.json")
+    try:
+        os.makedirs(os.path.dirname(extras_path), exist_ok=True)
+        with open(extras_path, "w") as f:
+            json.dump({"headline": headline, "extras": extras}, f, indent=1)
+    except OSError as e:
+        print(f"could not write extras blob: {e}", file=sys.stderr)
+        extras_path = None
+
+    def _pick(name, key):
+        v = extras.get(name, {})
+        return v.get(key) if isinstance(v, dict) else None
+
     line = {
         "metric": "mnist_cnn_images_per_sec_per_core",
         "value": headline["images_per_sec_per_core"],
@@ -600,7 +619,17 @@ def driver_run() -> int:
         "mfu_pct": headline.get("mfu_pct"),
         "vs_baseline": vs_baseline,
         "vs_baseline_basis": basis,
-        "extras": extras,
+        "highlights": {
+            "e2e_fit_img_s_core": _pick("mnist_cnn_e2e_fit",
+                                        "images_per_sec_per_core"),
+            "resnet50_bf16_mfu_pct": _pick("resnet50_bf16", "mfu_pct"),
+            "resnet50_fp32_mfu_pct": _pick("resnet50", "mfu_pct"),
+            "lm_bf16_mfu_pct": _pick("transformer_lm_bf16", "mfu_pct"),
+            "lm_bf16_tokens_s_core": _pick("transformer_lm_bf16",
+                                           "tokens_per_sec_per_core"),
+            "cpu_vs_reference": cpu.get("vs_reference"),
+        },
+        "extras_path": extras_path,
     }
     print(json.dumps(line))
     return 0

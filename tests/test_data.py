@@ -168,15 +168,15 @@ class TestMoreCombinators:
         assert list(ds.as_numpy_iterator()) == [0, 10, 1, 11, 2, 12]
 
     def test_interleave_uneven_streams_tf_ordering(self):
-        # tf.data semantics: the replacement stream takes over the
-        # exhausted stream's SLOT (and continues its block), so uneven
-        # stream lengths keep the deterministic mix.
+        # tf.data kernel semantics: when stream 0 ends, the cycle advances
+        # to slot 1 (emitting 11) and only opens stream 2 in slot 0 when
+        # the round-robin returns there — so 11 precedes 20.
         lengths = {0: 1, 1: 2, 2: 1}
         ds = Dataset.range(3).interleave(
             lambda i: Dataset.range(lengths[int(i)]).map(
                 lambda j, i=i: int(i) * 10 + j),
             cycle_length=2)
-        assert list(ds.as_numpy_iterator()) == [0, 10, 20, 11]
+        assert list(ds.as_numpy_iterator()) == [0, 10, 11, 20]
 
     def test_interleave_is_file_shard_replayable(self):
         ds = Dataset.range(4).interleave(lambda i: Dataset.range(2),

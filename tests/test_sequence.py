@@ -99,6 +99,16 @@ class TestRingAttention:
         with pytest.raises(ValueError, match="not divisible"):
             ring_attention(q, k, v, mesh=mesh, axis_name="seq")
 
+    def test_rejects_cross_attention_shapes(self, eight_devices):
+        # Self-attention contract (ADVICE r2): a K/V whose sequence length
+        # differs from q's would silently get a wrong causal mask (kv_pos is
+        # derived from q's shard length) — must raise instead.
+        mesh = make_mesh({"seq": 8})
+        q, _, _ = _qkv(ln=16)
+        k, _, v = _qkv(ln=8)
+        with pytest.raises(ValueError, match="self-attention"):
+            ring_attention(q, k, v, mesh=mesh, axis_name="seq")
+
     def test_custom_scale(self, eight_devices):
         mesh = make_mesh({"seq": 8})
         q, k, v = _qkv()

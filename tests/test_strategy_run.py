@@ -227,6 +227,18 @@ class TestDistributeDatasetsFromFunction:
         assert (strategy.experimental_distribute_datasets_from_function
                 == strategy.distribute_datasets_from_function)
 
+    def test_uneven_replicas_per_worker_raises(self, eight_devices,
+                                               monkeypatch):
+        # ADVICE r2: flooring 8 replicas // 3 processes would silently
+        # mis-size the global batch; the wrapper must reject instead.
+        strategy = td.MirroredStrategy()
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 3)
+        with pytest.raises(ValueError, match="divisible by process_count"):
+            strategy.distribute_datasets_from_function(
+                lambda ctx: td.data.Dataset.range(8))
+
     def test_feeds_fit(self, eight_devices):
         strategy = td.MirroredStrategy()
 

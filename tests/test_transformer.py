@@ -83,6 +83,48 @@ class TestMultiHeadAttention:
                                       np.asarray(y2[:, :-1]))
         assert not np.allclose(np.asarray(y1[:, -1]), np.asarray(y2[:, -1]))
 
+    def test_attention_fn_causal_forwarded_when_unbound(self):
+        # A plain attention_fn (no causal= bound) must receive the LAYER's
+        # causal flag — the silent-non-causal footgun from ADVICE r2.
+        seen = {}
+
+        def attn(q, k, v, causal):
+            seen["causal"] = causal
+            return q
+
+        layer = MultiHeadAttention(num_heads=2, key_dim=8, causal=True,
+                                   attention_fn=attn)
+        params, state, _ = layer.init(jax.random.PRNGKey(0), (8, 16))
+        x = jnp.zeros((1, 8, 16), jnp.float32)
+        layer.apply(params, state, x)
+        assert seen["causal"] is True
+
+    def test_attention_fn_causal_conflict_raises(self):
+        attn = functools.partial(
+            lambda q, k, v, causal: q, causal=False)
+        layer = MultiHeadAttention(num_heads=2, key_dim=8, causal=True,
+                                   attention_fn=attn)
+        params, state, _ = layer.init(jax.random.PRNGKey(0), (8, 16))
+        with pytest.raises(ValueError, match="conflicts"):
+            layer.apply(params, state, jnp.zeros((1, 8, 16), jnp.float32))
+
+    def test_attention_fn_nested_partial_causal_respected(self):
+        # A causal=True bound on an INNER partial must be seen through an
+        # outer wrapper (at call time outer kwargs would override it, so
+        # the layer must not inject causal=False on top).
+        inner = functools.partial(lambda q, k, v, causal, scale: q,
+                                  causal=True)
+        outer = functools.partial(inner, scale=0.125)
+        layer = MultiHeadAttention(num_heads=2, key_dim=8, causal=False,
+                                   attention_fn=outer)
+        params, state, _ = layer.init(jax.random.PRNGKey(0), (8, 16))
+        with pytest.raises(ValueError, match="conflicts"):
+            layer.apply(params, state, jnp.zeros((1, 8, 16), jnp.float32))
+        ok = MultiHeadAttention(num_heads=2, key_dim=8, causal=True,
+                                attention_fn=outer)
+        params, state, _ = ok.init(jax.random.PRNGKey(0), (8, 16))
+        ok.apply(params, state, jnp.zeros((1, 8, 16), jnp.float32))
+
     def test_ring_attention_fn_matches_dense(self, eight_devices):
         mesh = make_mesh({"seq": 8})
         attn = functools.partial(ring_attention, mesh=mesh, axis_name="seq",

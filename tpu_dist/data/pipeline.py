@@ -366,28 +366,30 @@ class Dataset:
                 if s is None:
                     break
                 slots.append(s)
-            # tf.data ordering: an exhausted stream's SLOT is taken over by
-            # the next input's stream, which continues the current block —
-            # uneven stream lengths keep the documented deterministic mix.
+            # tf.data ordering (InterleaveDataset kernel): when a stream
+            # ends mid-block, advance to the NEXT cycle slot immediately;
+            # the emptied slot opens its replacement stream only when the
+            # round-robin cycle returns to it. (None marks an empty slot
+            # awaiting lazy refill.)
             i = 0
             while slots:
                 if i >= len(slots):
                     i = 0
+                if slots[i] is None:
+                    repl = new_stream()
+                    if repl is None:
+                        slots.pop(i)
+                        continue
+                    slots[i] = repl
                 emitted = 0
-                removed = False
                 while emitted < block_length:
                     try:
                         yield next(slots[i])
                         emitted += 1
                     except StopIteration:
-                        repl = new_stream()
-                        if repl is None:
-                            slots.pop(i)
-                            removed = True
-                            break
-                        slots[i] = repl
-                if not removed:
-                    i += 1
+                        slots[i] = None
+                        break
+                i += 1
 
         return self._derive(
             factory, cardinality=None,

@@ -26,6 +26,7 @@ LayerNorm statistics.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Callable, Optional
 
@@ -114,13 +115,15 @@ def _dense_attention(q, k, v, *, causal: bool, scale: float):
 class MultiHeadAttention(Layer):
     """Multi-head self-attention on a [.., L, D] stream.
 
-    ``attention_fn(q, k, v) -> out`` (shapes [B, H, L, key_dim]) swaps the
-    attention inner loop: default is dense softmax (``causal`` applies the
-    autoregressive mask); pass ``functools.partial(ring_attention, mesh=...,
-    axis_name='seq', causal=...)`` for sequence-parallel exact attention —
-    the projections stay identical, so the two paths are numerically
-    interchangeable (tests assert it). ``attention_fn`` models can't
-    full-model-serialize (a callable isn't JSON); save weights instead.
+    ``attention_fn(q, k, v, causal=...) -> out`` (shapes [B, H, L, key_dim])
+    swaps the attention inner loop: default is dense softmax (``causal``
+    applies the autoregressive mask); pass ``functools.partial(ring_attention,
+    mesh=..., axis_name='seq')`` for sequence-parallel exact attention — the
+    layer forwards its own ``causal`` flag (a partial that already binds
+    ``causal=`` must agree or apply() raises), so the flag can never be
+    silently dropped. The projections stay identical, so the two paths are
+    numerically interchangeable (tests assert it). ``attention_fn`` models
+    can't full-model-serialize (a callable isn't JSON); save weights instead.
     """
 
     num_heads: int
@@ -160,7 +163,27 @@ class MultiHeadAttention(Layer):
         k = self._heads(x, params["wk"], b("bk"))
         v = self._heads(x, params["wv"], b("bv"))
         if self.attention_fn is not None:
-            out = self.attention_fn(q, k, v)
+            # Forward the layer's causal flag so attention_fn models can't
+            # silently be non-causal (ADVICE r2). A functools.partial chain
+            # that already binds causal= must agree with the layer. Walk the
+            # whole chain: at call time an OUTER partial's kwargs override an
+            # inner one's, so the effective binding is innermost-first with
+            # outer layers winning.
+            chain, fn = [], self.attention_fn
+            while isinstance(fn, functools.partial):
+                chain.append(fn.keywords or {})
+                fn = fn.func
+            bound: dict = {}
+            for kw in reversed(chain):
+                bound.update(kw)
+            if "causal" in bound:
+                if bool(bound["causal"]) != bool(self.causal):
+                    raise ValueError(
+                        f"MultiHeadAttention(causal={self.causal}) conflicts "
+                        f"with attention_fn binding causal={bound['causal']}")
+                out = self.attention_fn(q, k, v)
+            else:
+                out = self.attention_fn(q, k, v, causal=self.causal)
         else:
             out = _dense_attention(q, k, v, causal=self.causal,
                                    scale=1.0 / math.sqrt(self.key_dim))

@@ -167,6 +167,15 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS,
         from jax.experimental.shard_map import shard_map
 
     axis_size = mesh.shape[axis_name]
+    # Self-attention contract (ADVICE r2): the causal kv_pos computation
+    # derives K/V global positions from q's per-shard length, so a K/V with
+    # a different (even if divisible) sequence length would silently get a
+    # wrong mask. Enforce the contract instead.
+    if k.shape != v.shape or k.shape[2] != q.shape[2]:
+        raise ValueError(
+            f"ring_attention is self-attention: q/k/v sequence lengths must "
+            f"match and k.shape == v.shape; got q={q.shape} k={k.shape} "
+            f"v={v.shape}")
     if q.shape[2] % axis_size:
         raise ValueError(
             f"sequence length {q.shape[2]} not divisible by mesh axis "

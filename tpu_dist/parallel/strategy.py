@@ -182,6 +182,14 @@ class Strategy:
         from tpu_dist.data.distribute import DistributedDataset
         from tpu_dist.data.pipeline import AutoShardPolicy, Dataset
 
+        if self.num_replicas_in_sync % jax.process_count():
+            # ADVICE r2: flooring the division would mis-size the global
+            # batch (some replicas starve) with no error — reject instead,
+            # BEFORE user code runs against the doomed InputContext.
+            raise ValueError(
+                f"num_replicas_in_sync ({self.num_replicas_in_sync}) must "
+                f"be divisible by process_count ({jax.process_count()}); "
+                "uneven replicas-per-worker is not supported")
         ctx = InputContext(
             num_input_pipelines=jax.process_count(),
             input_pipeline_id=jax.process_index(),
