@@ -170,6 +170,37 @@ class TestPallasCrossEntropy:
         out = fused_sparse_cross_entropy(logits, labels, interpret=True)
         assert float(jnp.max(jnp.abs(ref - out))) < 1e-5
 
+    def test_vmem_tile_picker(self):
+        # The (batch, classes)-aware picker (r3): shrinks rows as the class
+        # dim widens so the bwd kernel's ~5 (TB, C) fp32 buffers stay inside
+        # scoped VMEM; signals 0 (use the jnp path) when even 8 rows blow
+        # the budget (vocab > 64k); never exceeds the divisibility rule.
+        from tpu_dist.ops.pallas_kernels import _TILE_BYTES, _pick_tile
+
+        assert _pick_tile(1024, 10) == 128
+        tb = _pick_tile(32768, 8192)
+        assert tb * 8192 * 4 <= _TILE_BYTES and tb >= 8
+        assert _pick_tile(128, 131072) == 0  # Llama-scale vocab: jnp path
+
+    def test_rank3_logits_fall_back(self):
+        # [B, T, V] logits (outside the documented [B, C] contract) must
+        # divert to the rank-general jnp loss, not crash the tile picker.
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_dist.ops.losses import sparse_categorical_crossentropy
+        from tpu_dist.ops.pallas_kernels import fused_sparse_cross_entropy
+
+        logits = jnp.asarray(
+            np.random.default_rng(0).normal(size=(4, 16, 32)),
+            jnp.float32)
+        labels = jnp.asarray(
+            np.random.default_rng(1).integers(0, 32, size=(4, 16)))
+        ref = sparse_categorical_crossentropy(logits, labels,
+                                              from_logits=True)
+        out = fused_sparse_cross_entropy(logits, labels)
+        assert float(jnp.max(jnp.abs(ref - out))) < 1e-6
+
     def test_cpu_fallback_is_reference_impl(self):
         # On a non-TPU backend the public wrapper must silently use jnp math.
         from tpu_dist.ops.losses import sparse_categorical_crossentropy

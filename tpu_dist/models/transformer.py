@@ -111,6 +111,18 @@ def _dense_attention(q, k, v, *, causal: bool, scale: float):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _default_attention(q, k, v, *, causal: bool, scale: float):
+    """Single-device attention dispatch: the fused flash kernel
+    (ops/flash_attention.py) on TPU for supported shapes — O(L) memory,
+    tiled online softmax — else the dense reference path. TPU_DIST_FLASH=0
+    forces dense for A/B measurement."""
+    from tpu_dist.ops import flash_attention as fa
+
+    if fa.use_flash(q):
+        return fa.flash_attention(q, k, v, causal=causal, scale=scale)
+    return _dense_attention(q, k, v, causal=causal, scale=scale)
+
+
 @dataclasses.dataclass(frozen=True, repr=False)
 class MultiHeadAttention(Layer):
     """Multi-head self-attention on a [.., L, D] stream.
@@ -185,8 +197,8 @@ class MultiHeadAttention(Layer):
             else:
                 out = self.attention_fn(q, k, v, causal=self.causal)
         else:
-            out = _dense_attention(q, k, v, causal=self.causal,
-                                   scale=1.0 / math.sqrt(self.key_dim))
+            out = _default_attention(q, k, v, causal=self.causal,
+                                     scale=1.0 / math.sqrt(self.key_dim))
         out = jnp.moveaxis(out, -3, -2)  # [.., L, H, dk]
         *lead, ln, h, dk = out.shape
         out = out.reshape(*lead, ln, h * dk)

@@ -32,12 +32,23 @@ import jax.numpy as jnp
 
 TILE_B = 128  # batch rows per program; fp32 sublane min is 8, MXU-friendly
 
+#: VMEM bytes per (TILE_B, C) fp32 buffer before the tile shrinks. The bwd
+#: kernel holds ~5 such buffers (logits in, dlogits out, double-buffered
+#: pipelining); 2 MB each stays well inside the 16 MB scoped-vmem limit —
+#: at vocab-scale C (8192+) the old fixed 128-row tile blew it (r3: 20.25M
+#: scoped allocation compiling the transformer-LM fused loss).
+_TILE_BYTES = 2 * 1024 * 1024
 
-def _pick_tile(batch: int) -> int:
-    if batch % TILE_B == 0:
-        return TILE_B
-    for t in (64, 32, 16, 8):
-        if batch % t == 0:
+
+def _pick_tile(batch: int, classes: int = 0) -> int:
+    cap = TILE_B
+    if classes:
+        while cap > 8 and cap * classes * 4 > _TILE_BYTES:
+            cap //= 2
+        if cap * classes * 4 > _TILE_BYTES:
+            return 0  # even 8 rows blow VMEM (vocab > 64k): use jnp path
+    for t in (128, 64, 32, 16, 8):
+        if t <= cap and batch % t == 0:
             return t
     return batch  # tiny/ragged batch: single tile
 
@@ -64,7 +75,7 @@ def _ce_fwd(logits, labels, *, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     b, c = logits.shape
-    tb = _pick_tile(b)
+    tb = _pick_tile(b, c)
     labels2 = labels.astype(jnp.int32).reshape(b, 1)
     loss, lse = pl.pallas_call(
         _ce_fwd_kernel,
@@ -110,7 +121,7 @@ def _ce_bwd(logits, labels, lse, g, *, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     b, c = logits.shape
-    tb = _pick_tile(b)
+    tb = _pick_tile(b, c)
     labels2 = labels.astype(jnp.int32).reshape(b, 1)
     g2 = g.astype(jnp.float32).reshape(b, 1)
     space = pl.ANY if interpret else pltpu.VMEM
@@ -180,9 +191,13 @@ def fused_sparse_cross_entropy(logits, labels, *,
     """
     if interpret is None:
         interpret = False
-        # Fall back to jnp math off-TPU, and on-TPU for ragged batches whose
-        # only tile is sublane-unaligned (Mosaic wants multiples of 8 rows).
-        if not _on_tpu() or _pick_tile(logits.shape[0]) % 8 != 0:
+        # Fall back to jnp math off-TPU; on-TPU for non-[B, C] ranks (the
+        # jnp loss is rank-general), for batches whose only tile is
+        # sublane-unaligned (Mosaic wants multiples of 8 rows), and for
+        # vocabularies so wide even an 8-row tile blows the VMEM budget
+        # (_pick_tile returns 0).
+        tile = _pick_tile(*logits.shape) if logits.ndim == 2 else 0
+        if not _on_tpu() or tile == 0 or tile % 8 != 0:
             from tpu_dist.ops.losses import sparse_categorical_crossentropy
 
             return sparse_categorical_crossentropy(logits, labels,
