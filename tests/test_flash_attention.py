@@ -107,8 +107,14 @@ def test_supported_predicate():
 
 
 def test_use_flash_env_off(monkeypatch):
+    # Stub the backend probe so the env gate is what's actually under test
+    # (on the CPU runner _on_tpu() is already False and would mask a broken
+    # gate).
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    x = jnp.zeros((2, 4, 256, 64))
+    assert fa.use_flash(x)
     monkeypatch.setenv("TPU_DIST_FLASH", "0")
-    assert not fa.use_flash(jnp.zeros((2, 4, 256, 64)))
+    assert not fa.use_flash(x)
 
 
 def test_mha_layer_unchanged_on_cpu():

@@ -182,6 +182,25 @@ class TestPallasCrossEntropy:
         assert tb * 8192 * 4 <= _TILE_BYTES and tb >= 8
         assert _pick_tile(128, 131072) == 0  # Llama-scale vocab: jnp path
 
+    def test_interpret_ignores_vmem_budget(self):
+        # Explicit interpret=True runs shapes the hardware budget refuses
+        # (the interpreter has no VMEM); the tile-0 signal must not reach
+        # the grid divide.
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tpu_dist.ops.losses import sparse_categorical_crossentropy
+        from tpu_dist.ops.pallas_kernels import fused_sparse_cross_entropy
+
+        logits = jnp.asarray(
+            np.random.default_rng(0).normal(size=(8, 131072)), jnp.float32)
+        labels = jnp.asarray(
+            np.random.default_rng(1).integers(0, 131072, size=(8,)))
+        ref = sparse_categorical_crossentropy(logits, labels,
+                                              from_logits=True)
+        out = fused_sparse_cross_entropy(logits, labels, interpret=True)
+        assert float(jnp.max(jnp.abs(ref - out))) < 1e-4
+
     def test_rank3_logits_fall_back(self):
         # [B, T, V] logits (outside the documented [B, C] contract) must
         # divert to the rank-general jnp loss, not crash the tile picker.

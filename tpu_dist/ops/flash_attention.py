@@ -276,6 +276,33 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
+# -- backward: fused single-tile dq, dk, dv -----------------------------------
+
+
+def _dqkv_single_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dq_ref, dk_ref, dv_ref, *, causal, scale):
+    """When L fits one [G, T, T] score tile (the benchmark LM's shape),
+    the split dq / dkv kernels each recompute the same s and p and each
+    re-read the operands; this fused variant computes them once and emits
+    all three grads — half the backward programs, one shared recompute."""
+    q = q_ref[:]                                           # (G, T, D)
+    k = k_ref[:]
+    v = v_ref[:]
+    do = do_ref[:]
+    lse = lse_ref[:]                                       # (G, T, 1)
+    delta = delta_ref[:]
+    s = _bdot(q, k, ((2,), (2,))) * scale                  # (G, T, T) f32
+    if causal:
+        s = _mask_tile(s, 0, 0)
+    p = jnp.exp(s - lse)
+    dv_ref[:] = _bdot(p.astype(do.dtype), do,
+                      ((1,), (1,))).astype(dv_ref.dtype)
+    dp = _bdot(do, v, ((2,), (2,)))                        # (G, T, T) f32
+    ds = (p * (dp - delta) * scale).astype(q.dtype)
+    dq_ref[:] = _bdot(ds, k, ((2,), (1,))).astype(dq_ref.dtype)
+    dk_ref[:] = _bdot(ds, q, ((1,), (1,))).astype(dk_ref.dtype)
+
+
 def _bwd(q3, k3, v3, o3, lse, g3, causal, scale, interpret, g, tq, tk):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -296,6 +323,23 @@ def _bwd(q3, k3, v3, o3, lse, g3, causal, scale, interpret, g, tq, tk):
                              memory_space=space)
     stat_full = pl.BlockSpec((g, ln, 1), lambda b, i: (b, 0, 0),
                              memory_space=space)
+
+    if nq == 1 and nk == 1:
+        return pl.pallas_call(
+            functools.partial(_dqkv_single_kernel, causal=causal,
+                              scale=scale),
+            grid=(bh // g,),
+            in_specs=[pl.BlockSpec((g, ln, d), lambda b: (b, 0, 0),
+                                   memory_space=space)] * 4
+            + [pl.BlockSpec((g, ln, 1), lambda b: (b, 0, 0),
+                            memory_space=space)] * 2,
+            out_specs=[pl.BlockSpec((g, ln, d), lambda b: (b, 0, 0),
+                                    memory_space=space)] * 3,
+            out_shape=[jax.ShapeDtypeStruct((bh, ln, d), q3.dtype),
+                       jax.ShapeDtypeStruct((bh, ln, d), k3.dtype),
+                       jax.ShapeDtypeStruct((bh, ln, d), v3.dtype)],
+            interpret=interpret,
+        )(q3, k3, v3, g3, lse, delta)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, nk=nk,
@@ -352,11 +396,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 # -- public wrapper -----------------------------------------------------------
 
 
-def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() == "tpu"
-    except Exception:
-        return False
+from tpu_dist.ops.pallas_kernels import _on_tpu
 
 
 def supported(q) -> bool:

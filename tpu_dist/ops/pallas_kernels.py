@@ -75,7 +75,9 @@ def _ce_fwd(logits, labels, *, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     b, c = logits.shape
-    tb = _pick_tile(b, c)
+    # The interpreter has no VMEM limit: ignore the class-width budget there
+    # (tile 0 = "won't fit on hardware" must not reach the grid divide).
+    tb = _pick_tile(b, 0 if interpret else c)
     labels2 = labels.astype(jnp.int32).reshape(b, 1)
     loss, lse = pl.pallas_call(
         _ce_fwd_kernel,
@@ -121,7 +123,7 @@ def _ce_bwd(logits, labels, lse, g, *, interpret: bool):
     from jax.experimental.pallas import tpu as pltpu
 
     b, c = logits.shape
-    tb = _pick_tile(b, c)
+    tb = _pick_tile(b, 0 if interpret else c)
     labels2 = labels.astype(jnp.int32).reshape(b, 1)
     g2 = g.astype(jnp.float32).reshape(b, 1)
     space = pl.ANY if interpret else pltpu.VMEM
@@ -189,17 +191,22 @@ def fused_sparse_cross_entropy(logits, labels, *,
     for inference/eval or large-vocabulary heads, not for the reference's
     tiny-classifier training loop.
     """
+    # Rank-general: [.., C] logits with [..] labels flatten to one [B, C]
+    # kernel call (the LM loss arrives as [B, L, V]); losses reshape back.
+    lead = logits.shape[:-1]
+    if logits.ndim != 2:
+        logits = logits.reshape(-1, logits.shape[-1])
+        labels = labels.reshape(-1)
     if interpret is None:
         interpret = False
-        # Fall back to jnp math off-TPU; on-TPU for non-[B, C] ranks (the
-        # jnp loss is rank-general), for batches whose only tile is
+        # Fall back to jnp math off-TPU, for batches whose only tile is
         # sublane-unaligned (Mosaic wants multiples of 8 rows), and for
         # vocabularies so wide even an 8-row tile blows the VMEM budget
         # (_pick_tile returns 0).
-        tile = _pick_tile(*logits.shape) if logits.ndim == 2 else 0
+        tile = _pick_tile(*logits.shape)
         if not _on_tpu() or tile == 0 or tile % 8 != 0:
             from tpu_dist.ops.losses import sparse_categorical_crossentropy
 
-            return sparse_categorical_crossentropy(logits, labels,
-                                                   from_logits=True)
-    return _fused_ce(logits, labels, interpret)
+            return sparse_categorical_crossentropy(
+                logits, labels, from_logits=True).reshape(lead)
+    return _fused_ce(logits, labels, interpret).reshape(lead)
