@@ -54,6 +54,91 @@ class TestLayers:
         np.testing.assert_array_equal(
             y[0, :, :, 0], [[5, 7], [13, 15]])
 
+    def test_pool_fast_path_matches_reduce_window(self):
+        # The non-overlapping reshape+reduce pool (CPU-deficit fix, r3)
+        # must equal lax.reduce_window exactly FORWARD, including odd
+        # extents (VALID crops the trailing row/col in both formulations).
+        # Gradients agree only on tie-free inputs: on tied window maxima
+        # the reduce-max VJP splits the cotangent where select_and_scatter
+        # one-hots it — which is why the fast path is CPU-only (see
+        # test_pool_tie_gradient_splits below).
+        rng = np.random.default_rng(0)
+        for h, w in ((4, 4), (5, 7), (28, 28)):
+            x = jnp.asarray(rng.normal(size=(2, h, w, 3)), jnp.float32)
+            got = MaxPooling2D().apply({}, {}, x)[0]
+            want = jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+                "VALID")
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+        g = jax.grad(lambda x: (MaxPooling2D().apply(
+            {}, {}, x)[0] ** 2).sum())(x)
+        g_ref = jax.grad(lambda x: (jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
+            "VALID") ** 2).sum())(x)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.skipif(jax.default_backend() != "cpu",
+                        reason="fast path (and its tie semantics) is "
+                               "CPU-only")
+    def test_pool_tie_gradient_splits(self):
+        # Documented divergence under ties (common post-ReLU): the CPU
+        # fast path's reduce-max VJP splits the cotangent evenly across
+        # tied maxima; select_and_scatter would route it to one element.
+        # Expected-loss identical, per-element gradient differs — pinned
+        # here so the trade-off is explicit, not accidental.
+        x = jnp.zeros((1, 2, 2, 1), jnp.float32)
+        g = jax.grad(lambda x: MaxPooling2D().apply(
+            {}, {}, x)[0].sum())(x)
+        np.testing.assert_allclose(np.asarray(g)[0, :, :, 0],
+                                   np.full((2, 2), 0.25), rtol=0, atol=0)
+
+    def test_pool_overlapping_windows_still_reduce_window(self):
+        # stride != pool keeps the general path; values must match the
+        # sliding-window definition.
+        x = jnp.arange(25, dtype=jnp.float32).reshape(1, 5, 5, 1)
+        y = MaxPooling2D(pool_size=3, strides=1).apply({}, {}, x)[0]
+        assert y.shape == (1, 3, 3, 1)
+        assert float(y[0, 0, 0, 0]) == 12.0  # max of the top-left 3x3
+
+    def test_conv_im2col_matches_lax(self):
+        # The CPU stem fast path (r3): same contraction as lax conv to
+        # fp32 tolerance, forward and gradients.
+        from tpu_dist.models.layers import _conv_im2col
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(4, 12, 12, 2)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(3, 3, 2, 8)), jnp.float32)
+
+        def ref(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (1, 1), "VALID",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+        np.testing.assert_allclose(np.asarray(_conv_im2col(x, w)),
+                                   np.asarray(ref(x, w)),
+                                   rtol=1e-5, atol=1e-5)
+        g1 = jax.grad(lambda x, w: (_conv_im2col(x, w) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: (ref(x, w) ** 2).sum(),
+                      argnums=(0, 1))(x, w)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.skipif(jax.default_backend() != "cpu",
+                        reason="im2col gate is CPU-only by design")
+    def test_conv_fast_path_gate(self):
+        # im2col only for narrow stems on CPU: stride-1 VALID and
+        # kh*kw*cin <= 64; everything else keeps the native conv.
+        x1 = jnp.zeros((1, 8, 8, 1))
+        x32 = jnp.zeros((1, 8, 8, 32))
+        assert Conv2D(8, 3)._use_im2col(x1)
+        assert not Conv2D(8, 3)._use_im2col(x32)       # 288 cols
+        assert not Conv2D(8, 3, strides=2)._use_im2col(x1)
+        assert not Conv2D(8, 3, padding="same")._use_im2col(x1)
+
     def test_avgpool(self):
         x = jnp.ones((1, 4, 4, 2))
         _, out_shape, y, _ = _init_apply(AveragePooling2D(), (4, 4, 2), x)

@@ -112,20 +112,69 @@ class Conv2D(Layer):
         return params, {}, (oh, ow, self.filters)
 
     def apply(self, params, state, x, *, training=False, rng=None):
-        y = jax.lax.conv_general_dilated(
-            x, params["kernel"].astype(x.dtype),
-            window_strides=_pair(self.strides),
-            padding=self.padding.upper(),
-            dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        )
+        kernel = params["kernel"].astype(x.dtype)
+        if self._use_im2col(x):
+            y = _conv_im2col(x, kernel)
+        else:
+            y = jax.lax.conv_general_dilated(
+                x, kernel,
+                window_strides=_pair(self.strides),
+                padding=self.padding.upper(),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
         if self.use_bias:
             y = y + params["bias"].astype(y.dtype)
         return _activation(self.activation)(y), state
+
+    def _use_im2col(self, x):
+        """CPU stem fast path: XLA:CPU's conv GRADIENTS are naive loops
+        (r3 audit: the 1-channel 28x28 stem's fwd+bwd took 61 ms at batch
+        128 vs 17 ms as slice-concat patches + one matmul, whose backward
+        is matmuls + static pads). Only worth it while the patch blowup
+        (kh*kw*cin columns) stays small — wide-channel convs lose to the
+        native path. TPU always takes lax conv (MXU-native)."""
+        if jax.default_backend() != "cpu":
+            return False
+        kh, kw = _pair(self.kernel_size)
+        return (_pair(self.strides) == (1, 1)
+                and self.padding.upper() == "VALID"
+                and kh * kw * x.shape[-1] <= 64)
+
+
+def _conv_im2col(x, w):
+    """VALID stride-1 conv as slice-concat patches + one matmul — same
+    contraction, CPU-friendly gradients (see Conv2D._use_im2col)."""
+    kh, kw, cin, cout = w.shape
+    b, h, ww_, _ = x.shape
+    oh, ow = h - kh + 1, ww_ - kw + 1
+    cols = [x[:, i:i + oh, j:j + ow, :]
+            for i in range(kh) for j in range(kw)]
+    patches = jnp.concatenate(cols, axis=-1)       # [B, oh, ow, kh*kw*cin]
+    out = patches.reshape(b * oh * ow, kh * kw * cin) @ w.reshape(
+        kh * kw * cin, cout)
+    return out.reshape(b, oh, ow, cout)
 
 
 def _pool(x, window, strides, padding, init_val, op):
     wh, ww = _pair(window)
     sh, sw = _pair(strides)
+    if ((sh, sw) == (wh, ww) and padding.upper() == "VALID"
+            and op in (jax.lax.max, jax.lax.add)
+            and jax.default_backend() == "cpu"):
+        # Non-overlapping windows (the reference's pool_size=2 default):
+        # reshape + axis-reduce is exactly reduce_window VALID forward
+        # (both crop trailing rows/cols), but its GRADIENT is an equality
+        # mask — on tied window maxima it SPLITS the cotangent instead of
+        # select_and_scatter's one-hot routing. CPU-only: XLA:CPU lowers
+        # select_and_scatter to a ~200 ms/step scatter loop at the
+        # reference's batch (pools were 2/3 of the whole step); TPU keeps
+        # reduce_window so its gradient semantics are unchanged.
+        b, h, w, c = x.shape
+        oh, ow = h // wh, w // ww
+        x = x[:, :oh * wh, :ow * ww, :]
+        x = x.reshape(b, oh, wh, ow, ww, c)
+        reducer = jnp.max if op is jax.lax.max else jnp.sum
+        return reducer(x, axis=(2, 4))
     return jax.lax.reduce_window(
         x, init_val, op,
         window_dimensions=(1, wh, ww, 1),
