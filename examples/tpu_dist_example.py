@@ -42,6 +42,7 @@ strategy = td.MultiWorkerMirroredStrategy(
     td.CollectiveCommunication.AUTO)
 # strategy = td.MirroredStrategy()   # single-host multi-device alternative
 
+td.data.disable_progress_bar()            # reference tf_dist_example.py:15
 BUFFER_SIZE = 10000                       # reference tf_dist_example.py:16-18
 NUM_WORKERS = max(td.cluster.process_count(), 1)
 GLOBAL_BATCH_SIZE = 64 * NUM_WORKERS
@@ -53,8 +54,11 @@ def make_datasets_unbatched():
         image = jnp.asarray(image, jnp.float32) / 255.0
         return image, label
 
-    datasets = td.data.load("mnist", split="train", as_supervised=True)
-    return datasets.map(scale).cache().shuffle(BUFFER_SIZE)
+    datasets, info = td.data.load(with_info=True,
+                                  name="mnist",
+                                  as_supervised=True)
+
+    return datasets["train"].map(scale).cache().shuffle(BUFFER_SIZE)
 
 
 train_datasets = make_datasets_unbatched().batch(GLOBAL_BATCH_SIZE)

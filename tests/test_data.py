@@ -279,6 +279,87 @@ class TestSources:
         el = next(iter(ds))
         assert set(el) == {"image", "label"}
 
+    def test_load_reference_call_shape(self):
+        # The reference's literal call (tf_dist_example.py:27-31):
+        # tfds.load(with_info=True, name='mnist', as_supervised=True),
+        # then datasets['train']. Must transliterate with no shape changes.
+        datasets, info = load(with_info=True, name="mnist",
+                              as_supervised=True, synthetic_size=16)
+        assert set(datasets) == {"train", "test"}
+        x, y = next(iter(datasets["train"]))
+        assert x.shape == (28, 28, 1)
+        assert info.splits["train"].num_examples == datasets[
+            "train"].cardinality()
+        assert info.splits["test"].num_examples == datasets[
+            "test"].cardinality()
+        assert info.num_classes == 10 and info.image_shape == (28, 28, 1)
+
+    def test_load_no_split_returns_dict(self):
+        datasets = load("cifar10", synthetic_size=8)
+        assert set(datasets) == {"train", "test"}
+        assert datasets["train"].cardinality() == 8
+
+    def test_load_with_info_single_split(self):
+        ds, info = load("mnist", split="test", with_info=True,
+                        synthetic_size=8)
+        el = next(iter(ds))
+        assert len(el) == 2
+        assert info.splits["test"].num_examples == 8
+        assert info.synthetic  # no real MNIST in this environment
+        # tfds lists every official split even when one was requested.
+        assert set(info.splits) == {"train", "test"}
+        assert info.splits["train"].num_examples == 8
+
+    def test_load_info_reflects_real_files(self, tmp_path, monkeypatch):
+        # With a real (written) sharded copy on disk, info must report the
+        # served cardinality and synthetic=False for that split.
+        from tpu_dist.data.sources import write_sharded
+        rng = np.random.default_rng(0)
+        x = rng.integers(0, 255, size=(24, 28, 28, 1)).astype(np.uint8)
+        y = rng.integers(0, 10, size=(24,)).astype(np.int64)
+        write_sharded(tmp_path, "mnist", "train", x, y, num_shards=3)
+        monkeypatch.setenv("TPU_DIST_DATA_DIR", str(tmp_path))
+        ds, info = load("mnist", split="train", with_info=True)
+        assert info.splits["train"].num_examples == 24
+        assert not info.synthetic
+        assert ds.num_files == 3
+
+    def test_disable_progress_bar_noop(self):
+        from tpu_dist.data import disable_progress_bar
+        disable_progress_bar()
+
+    def test_load_rejects_unknown_split(self):
+        with pytest.raises(ValueError, match="split must be"):
+            load("mnist", split="validation", synthetic_size=8)
+
+    def test_load_splits_are_lazy(self, monkeypatch):
+        # The reference only consumes datasets['train']; the test split
+        # must not be synthesized/read until touched.
+        import tpu_dist.data.sources as sources
+        calls = []
+        real = sources._one_split
+
+        def spy(name, split, *a, **kw):
+            calls.append(split)
+            return real(name, split, *a, **kw)
+
+        monkeypatch.setattr(sources, "_one_split", spy)
+        datasets, info = load(with_info=True, name="mnist",
+                              synthetic_size=8)
+        assert calls == []
+        assert info.splits["train"].num_examples == 8
+        assert calls == ["train"]
+        # A pure info query is not "serving": synthetic stays False until
+        # a Dataset is actually handed out.
+        assert not info.synthetic
+        next(iter(datasets["train"]))
+        assert calls == ["train"]  # cached, not rebuilt
+        assert info.synthetic
+        datasets["test"]
+        assert calls == ["train", "test"]
+        with pytest.raises(KeyError):
+            datasets["validation"]
+
 
 class TestDistributedDelivery:
     def test_off_policy_batches_shard_across_local_devices(self, eight_devices):

@@ -2,6 +2,9 @@
 
 from tpu_dist.data.pipeline import AutoShardPolicy, Dataset, Options
 from tpu_dist.data.sources import (
+    DatasetInfo,
+    SplitInfo,
+    disable_progress_bar,
     image_shape,
     load,
     load_arrays,
@@ -18,7 +21,10 @@ __all__ = [
     "write_sharded",
     "AutoShardPolicy",
     "Dataset",
+    "DatasetInfo",
     "Options",
+    "SplitInfo",
+    "disable_progress_bar",
     "image_shape",
     "load",
     "load_arrays",

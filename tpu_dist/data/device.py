@@ -17,7 +17,7 @@ same composition semantics (map/scale, per-epoch reshuffle, batch), one
 transfer total.
 
 Semantics: equivalent to the reference pipeline
-``load(name).map(scale).cache().shuffle(FULL).batch(B, drop_remainder=True)``
+``load(name, "train").map(scale).cache().shuffle(FULL).batch(B, drop_remainder=True)``
 with a SEEDED per-epoch reshuffle shared by all processes — i.e. the
 single-program Mirrored semantic: one global permutation, every replica
 taking its shard of each global batch (SURVEY.md D14).

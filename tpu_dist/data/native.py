@@ -184,7 +184,7 @@ def native_pipeline(name: str, *, global_batch_size: int, seed: int = 0,
     """A ``Dataset`` over a named source whose batches are assembled by the
     native core: per-epoch seeded reshuffle, fused gather+normalize.
 
-    Semantically equals ``load(name).map(scale).cache().shuffle(N).batch(B)``
+    Semantically equals ``load(name, "train").map(scale).cache().shuffle(N).batch(B)``
     (the reference pipeline, tf_dist_example.py:20-33) with a full-dataset
     shuffle buffer; plugs into ``fit``/``experimental_distribute_dataset``
     like any other Dataset, including the shard-policy machinery.

@@ -16,6 +16,8 @@ three benchmark datasets (BASELINE.md configs). Resolution order per dataset:
 
 from __future__ import annotations
 
+import collections.abc
+import dataclasses
 import gzip
 import logging
 import os
@@ -134,18 +136,28 @@ def _synthetic(name: str, split: str, size: int | None) -> tuple[np.ndarray, np.
     return images, labels
 
 
+def _resolve_arrays(name: str, split: str, synthetic_size: int | None
+                    ) -> tuple[np.ndarray, np.ndarray, bool]:
+    """(images, labels, found_locally) — the one place the local-then-
+    synthetic fallback order is defined (load_arrays and _one_split share
+    it so the two entry points can never drift)."""
+    if name not in _SPECS:
+        raise ValueError(f"unknown dataset {name!r}; available: {sorted(_SPECS)}")
+    valid = tuple(_SPECS[name][2])
+    if split not in valid:
+        raise ValueError(f"split must be one of {valid}, got {split!r}")
+    found = _try_local(name, split)
+    if found is not None:
+        return (*found, True)
+    return (*_synthetic(name, split, synthetic_size), False)
+
+
 def load_arrays(name: str, split: str = "train", *,
                 synthetic_size: int | None = None
                 ) -> tuple[np.ndarray, np.ndarray]:
     """(images uint8 [N,H,W,C], labels int64 [N]) for a named dataset."""
-    if name not in _SPECS:
-        raise ValueError(f"unknown dataset {name!r}; available: {sorted(_SPECS)}")
-    if split not in ("train", "test"):
-        raise ValueError(f"split must be 'train' or 'test', got {split!r}")
-    found = _try_local(name, split)
-    if found is not None:
-        return found
-    return _synthetic(name, split, synthetic_size)
+    x, y, _ = _resolve_arrays(name, split, synthetic_size)
+    return x, y
 
 
 def _find_shard_files(name: str, split: str) -> list[pathlib.Path]:
@@ -219,17 +231,123 @@ def write_sharded(directory, name: str, split: str, images: np.ndarray,
     return paths
 
 
-def load(name: str, split: str = "train", *, as_supervised: bool = True,
-         synthetic_size: int | None = None) -> Dataset:
-    """tfds.load-shaped entry point (tf_dist_example.py:15 usage):
-    ``load('mnist', split='train', as_supervised=True)`` yields
-    ``(image, label)`` tuples; ``as_supervised=False`` yields dicts.
+@dataclasses.dataclass(frozen=True)
+class SplitInfo:
+    """One entry of :attr:`DatasetInfo.splits` — the tfds surface the
+    reference touches is ``info.splits['train'].num_examples``."""
+    name: str
+    num_examples: int
 
-    If sharded npz files exist (see :func:`write_sharded`), the result is a
-    file-backed Dataset (``num_files > 1``) eligible for
-    AutoShardPolicy.FILE/AUTO file-level sharding across workers."""
-    if name not in _SPECS:
-        raise ValueError(f"unknown dataset {name!r}; available: {sorted(_SPECS)}")
+
+class _SplitBuilder:
+    """Shared lazy build-and-cache of per-split Datasets, so that
+    ``load(name)`` (splits dict) and ``DatasetInfo`` can both defer the
+    actual file reads / synthesis until a split is touched — the reference
+    flow only ever consumes ``datasets['train']``."""
+
+    def __init__(self, name: str, splits: tuple[str, ...],
+                 as_supervised: bool, synthetic_size: int | None):
+        self.name, self.splits = name, splits
+        self._as_supervised, self._size = as_supervised, synthetic_size
+        self._cache: dict[str, tuple[Dataset, bool]] = {}
+        self._served: set[str] = set()
+
+    def get(self, split: str, *, serve: bool = True) -> tuple[Dataset, bool]:
+        if split not in self._cache:
+            self._cache[split] = _one_split(
+                self.name, split, self._as_supervised, self._size)
+        if serve:
+            self._served.add(split)
+        return self._cache[split]
+
+    def any_synthetic(self) -> bool:
+        # Only splits actually SERVED (handed to the caller as a Dataset):
+        # a pure info.splits[...].num_examples query builds the split but
+        # must not make a run that trained on real data report synthetic.
+        return any(self._cache[s][1] for s in self._served)
+
+
+class _LazySplits(collections.abc.Mapping):
+    """The ``datasets`` mapping ``load(name)`` returns: fixed key set,
+    values built on first access."""
+
+    def __init__(self, builder: _SplitBuilder):
+        self._builder = builder
+
+    def __getitem__(self, split: str) -> Dataset:
+        if split not in self._builder.splits:
+            raise KeyError(split)
+        return self._builder.get(split)[0]
+
+    def __iter__(self):
+        return iter(self._builder.splits)
+
+    def __len__(self):
+        return len(self._builder.splits)
+
+    def __repr__(self):
+        return "{%s}" % ", ".join(
+            f"{s!r}: <lazy Dataset>" for s in self._builder.splits)
+
+
+class _LazySplitInfos(collections.abc.Mapping):
+    """``info.splits``: SplitInfo built from the (lazily constructed)
+    split's cardinality on first access."""
+
+    def __init__(self, builder: _SplitBuilder):
+        self._builder = builder
+
+    def __getitem__(self, split: str) -> SplitInfo:
+        if split not in self._builder.splits:
+            raise KeyError(split)
+        ds, _ = self._builder.get(split, serve=False)
+        return SplitInfo(split, ds.cardinality())
+
+    def __iter__(self):
+        return iter(self._builder.splits)
+
+    def __len__(self):
+        return len(self._builder.splits)
+
+
+class DatasetInfo:
+    """Minimal ``tfds.core.DatasetInfo`` equivalent for the datasets this
+    framework serves: split cardinalities plus the feature facts every
+    consumer in the reference flow needs (image shape, class count).
+    ``splits`` and ``synthetic`` evaluate lazily so asking about one split
+    never pays for the others."""
+
+    def __init__(self, name: str, builder: _SplitBuilder):
+        self.name = name
+        self._builder = builder
+        self.image_shape, self.num_classes, _ = _SPECS[name]
+        self.splits: Mapping[str, SplitInfo] = _LazySplitInfos(builder)
+
+    @property
+    def synthetic(self) -> bool:
+        """True when any split SERVED SO FAR fell back to synthetic data
+        (False before any split has been consumed — probing would defeat
+        the lazy build)."""
+        return self._builder.any_synthetic()
+
+    def __repr__(self):
+        return (f"DatasetInfo(name={self.name!r}, "
+                f"image_shape={self.image_shape}, "
+                f"num_classes={self.num_classes}, "
+                f"splits={list(self._builder.splits)})")
+
+
+def disable_progress_bar() -> None:
+    """tfds.disable_progress_bar() analog (tf_dist_example.py:15). This
+    loader never downloads, so there is no bar to disable; provided so the
+    reference program transliterates line for line."""
+
+
+def _one_split(name: str, split: str, as_supervised: bool,
+               synthetic_size: int | None) -> tuple[Dataset, bool]:
+    """(dataset, served_synthetic) for one named split. Resolution order:
+    sharded npz files, then single-file local copies, then deterministic
+    synthetic data (each source loaded at most once)."""
     shards = _find_shard_files(name, split)
     if shards:
         # Per-file cardinality from the shard headers: npz loads lazily
@@ -243,17 +361,49 @@ def load(name: str, split: str = "train", *, as_supervised: bool = True,
                     name, split, len(shards), sum(counts))
         if as_supervised:
             return Dataset.from_files(shards, _read_shard,
-                                      file_cardinalities=counts)
+                                      file_cardinalities=counts), False
         return Dataset.from_files(
             shards,
             lambda p: ({"image": x, "label": y} for x, y in _read_shard(p)),
-            file_cardinalities=counts)
-    x, y = load_arrays(name, split, synthetic_size=synthetic_size)
+            file_cardinalities=counts), False
+    x, y, found_locally = _resolve_arrays(name, split, synthetic_size)
     if as_supervised:
         ds = Dataset.from_tensor_slices((x, y))
     else:
         ds = Dataset.from_tensor_slices({"image": x, "label": y})
-    return ds
+    return ds, not found_locally
+
+
+def load(name: str, split: str | None = None, *, as_supervised: bool = True,
+         with_info: bool = False, synthetic_size: int | None = None):
+    """tfds.load-shaped entry point (tf_dist_example.py:15, 27-31).
+
+    Mirrors the reference's exact call shapes:
+
+    - ``load('mnist', split='train')`` → one :class:`Dataset` of
+      ``(image, label)`` tuples (``as_supervised=False`` → dicts).
+    - ``load(name='mnist')`` (no split) → ``{'train': Dataset, 'test':
+      Dataset}`` — the reference indexes ``datasets['train']``.
+    - ``with_info=True`` → ``(result, DatasetInfo)`` where
+      ``info.splits['train'].num_examples`` reports the cardinality of the
+      data actually served (real files when found, synthetic otherwise).
+
+    If sharded npz files exist (see :func:`write_sharded`), a split is a
+    file-backed Dataset (``num_files > 1``) eligible for
+    AutoShardPolicy.FILE/AUTO file-level sharding across workers."""
+    if name not in _SPECS:
+        raise ValueError(f"unknown dataset {name!r}; available: {sorted(_SPECS)}")
+    all_splits = tuple(_SPECS[name][2])
+    if split is not None and split not in all_splits:
+        raise ValueError(f"split must be one of {all_splits}, got {split!r}")
+    # The builder always spans every official split (tfds's info.splits
+    # lists them all even when one split was requested); the returned
+    # mapping/Dataset covers only what was asked for.
+    builder = _SplitBuilder(name, all_splits, as_supervised, synthetic_size)
+    result = _LazySplits(builder) if split is None else builder.get(split)[0]
+    if not with_info:
+        return result
+    return result, DatasetInfo(name, builder)
 
 
 def num_classes(name: str) -> int:
