@@ -28,24 +28,43 @@ CONFIG_NAME = "model.json"
 
 def _encode_value(v):
     from tpu_dist.models.layers import Layer
+    from tpu_dist.parallel.sequence import RingAttention
 
     if isinstance(v, Layer):
         return {"__layer__": layer_config(v)}
+    if isinstance(v, RingAttention):
+        # Declarative attention spec (VERDICT r2 #8): plain data, mesh
+        # resolved at call time from the restoring job's strategy scope.
+        # An explicitly bound mesh is deliberately NOT saved — topology is
+        # the restoring job's business, not the checkpoint's.
+        return {"__attention__": {
+            "class": "RingAttention",
+            "config": {k: getattr(v, k)
+                       for k in ("axis_name", "batch_axis", "scale")}}}
     if isinstance(v, (tuple, list)):
         return [_encode_value(e) for e in v]
     if callable(v):
         # e.g. MultiHeadAttention.attention_fn=partial(ring_attention, ...)
         raise TypeError(
             f"cannot serialize layer field holding a callable ({v!r}); "
-            "models with runtime hooks (e.g. a ring attention_fn) can't "
-            "full-model save — use save_weights()/load_weights and rebuild "
-            "the architecture in code")
+            "use the declarative spec (RingAttention(axis_name=...)) for "
+            "ring attention, or save_weights()/load_weights and rebuild "
+            "the architecture in code for arbitrary attention_fn hooks")
     return v
 
 
 def _decode_value(v):
     if isinstance(v, dict) and "__layer__" in v:
         return layer_from_config(v["__layer__"])
+    if isinstance(v, dict) and "__attention__" in v:
+        from tpu_dist.parallel import sequence as sequence_mod
+
+        spec = v["__attention__"]
+        cls = getattr(sequence_mod, spec["class"], None)
+        if cls is None or not isinstance(cls, type):
+            raise ValueError(
+                f"unknown attention spec class {spec['class']!r}")
+        return cls(**spec["config"])
     if isinstance(v, list):
         return tuple(_decode_value(e) for e in v)
     return v

@@ -134,8 +134,9 @@ class MultiHeadAttention(Layer):
     layer forwards its own ``causal`` flag (a partial that already binds
     ``causal=`` must agree or apply() raises), so the flag can never be
     silently dropped. The projections stay identical, so the two paths are
-    numerically interchangeable (tests assert it). ``attention_fn`` models
-    can't full-model-serialize (a callable isn't JSON); save weights instead.
+    numerically interchangeable (tests assert it). For full-model save use
+    the declarative spec (``tpu_dist.parallel.RingAttention``) — arbitrary
+    callables can't serialize; save weights and rebuild in code instead.
     """
 
     num_heads: int

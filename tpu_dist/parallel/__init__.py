@@ -20,6 +20,7 @@ from tpu_dist.parallel.collectives import (
 )
 from tpu_dist.parallel.sequence import (
     SEQ_AXIS,
+    RingAttention,
     ring_attention,
     sequence_sharding,
 )
@@ -50,6 +51,7 @@ __all__ = [
     "host_all_reduce_sum",
     "set_collective_logging",
     "SEQ_AXIS",
+    "RingAttention",
     "ring_attention",
     "sequence_sharding",
     "DefaultStrategy",
