@@ -489,11 +489,44 @@ def run_cpu_baseline() -> dict:
     # autoshard OFF each of its 2 workers draws its OWN batch of 128
     # (SURVEY.md §3.4), so 256 distinct images/step over 2 cores. Our SPMD
     # equivalent is one 256 batch sharded over 2 devices; per-core rates are
-    # then directly comparable.
+    # then directly comparable. Host pipeline, matching the TF reference's
+    # host-side tf.data stream — the device-resident pipeline's rate is in
+    # the breakdown, clearly labeled, not in the headline ratio.
     r = _run_child(["--e2e-child", "mnist_cnn", "--batch", "256",
                     "--epochs", "2", "--steps", "50", "--spe", "1",
                     "--pipeline", "host"], 2)
     r["mode"] = "cpu_baseline_like_for_like"
+    # Where the remaining gap lives (r3 audit, measured on the 1-core
+    # build host after the conv-im2col/pool fast paths): step-only equals
+    # e2e (input off the step path), and a single unpartitioned stream
+    # shows the 2-virtual-devices-on-1-core partition-emulation cost.
+    try:
+        r["breakdown"] = {
+            "e2e_2dev_device_pipeline": _run_child(
+                ["--e2e-child", "mnist_cnn", "--batch", "256",
+                 "--epochs", "1", "--steps", "50", "--spe", "1",
+                 "--pipeline", "device"], 2),
+            "step_only_2dev": _run_child(
+                ["--step-child", "mnist_cnn", "--batch", "256",
+                 "--steps", "60", "--warmup", "12", "--spe", "1",
+                 "--repeats", "2"], 2),
+            "single_stream_1dev_batch128": _run_child(
+                ["--step-child", "mnist_cnn", "--batch", "128",
+                 "--steps", "60", "--warmup", "12", "--spe", "1",
+                 "--repeats", "2"], 1),
+            "floor_note": (
+                "XLA:CPU conv floor (microbenched, batch 128): the wide "
+                "3x3x32->64 conv's best formulation is the native lax conv "
+                "(fwd 5.2 ms, +grads 21 ms); im2col and shifted-matmul "
+                "recasts lose 2-3x, and the --xla_cpu_use_onednn/xnnpack "
+                "flags measure as no-ops for conv here. TF/oneDNN runs the "
+                "same worker stream in ~45 ms vs our 50 ms (0.90x); the "
+                "rest of the gap is two partition threads timesharing one "
+                "physical core + per-step rendezvous sync, which real "
+                "multi-core workers don't pay."),
+        }
+    except Exception as e:
+        r["breakdown"] = {"error": f"{type(e).__name__}: {e}"[:300]}
     tf_ref = measure_tf_reference()
     if tf_ref is not None:
         ref_rate = tf_ref["images_per_sec_per_core"]

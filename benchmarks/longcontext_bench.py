@@ -14,8 +14,11 @@ Two instruments, matching the two environments this repo can use:
    device (exactly the path a user falls back to without a seq axis), and
    read XLA's buffer assignment via ``compiled.memory_analysis()`` —
    compile-time, so the dense side can "balloon" far past host RAM without
-   being executed. The ring program is additionally EXECUTED at every L to
-   prove the numbers describe a program that really runs.
+   being executed. The ring program is additionally EXECUTED up to
+   ``exec_max_len`` (default 16384) to prove the numbers describe a
+   program that really runs; beyond that the rows are compile-only
+   (``executed: false`` in the record) — a 1-core host would burn many
+   minutes of FLOPs proving nothing extra about memory.
 
 2. ``--tpu`` (single real chip): sweep the transformer LM's sequence length
    with the fused flash-attention kernel vs the naive dense path: step
@@ -62,9 +65,13 @@ def _memory_analysis(compiled):
 
 
 def run_mesh_sweep(lengths=(2048, 4096, 8192, 16384, 32768, 65536),
-                   batch=1, heads=8, head_dim=64, n_devices=8):
+                   batch=1, heads=8, head_dim=64, n_devices=8,
+                   exec_max_len=16384):
     """Per-device memory of ring vs dense attention loss+grad at fixed
-    per-problem shapes, growing global L. Ring also executes one step."""
+    per-problem shapes, growing global L. Ring also executes one step up
+    to ``exec_max_len`` (beyond that, a 1-core host would spend many
+    minutes on FLOPs that prove nothing extra — the memory numbers are
+    compile-time facts either way)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -107,18 +114,20 @@ def run_mesh_sweep(lengths=(2048, 4096, 8192, 16384, 32768, 65536),
                   .lower(shape, shape, shape).compile())
         row["ring"] = _memory_analysis(ring_c)
         row["ring"]["compile_s"] = round(time.perf_counter() - t0, 1)
-        key = jax.random.PRNGKey(0)
-        args = [jax.device_put(
-            jax.random.normal(jax.random.fold_in(key, i),
-                              (batch, heads, L, head_dim), jnp.float32),
-            seq_sh) for i in range(3)]
-        t0 = time.perf_counter()
-        jax.block_until_ready(ring_c(*args))
-        t1 = time.perf_counter()
-        jax.block_until_ready(ring_c(*args))
-        row["ring"]["step_s"] = round(time.perf_counter() - t1, 3)
-        row["ring"]["executed"] = True
-        del args
+        if L <= exec_max_len:
+            key = jax.random.PRNGKey(0)
+            args = [jax.device_put(
+                jax.random.normal(jax.random.fold_in(key, i),
+                                  (batch, heads, L, head_dim), jnp.float32),
+                seq_sh) for i in range(3)]
+            jax.block_until_ready(ring_c(*args))  # warm
+            t1 = time.perf_counter()
+            jax.block_until_ready(ring_c(*args))
+            row["ring"]["step_s"] = round(time.perf_counter() - t1, 3)
+            row["ring"]["executed"] = True
+            del args
+        else:
+            row["ring"]["executed"] = False
 
         # dense fallback: batch replicated, full context on every device
         # (what a no-seq-axis user runs). COMPILE ONLY — the score matrix

@@ -151,6 +151,23 @@ class Trainer:
                 lambda: tuple(m.init() for m in metrics))
         return self._metric_init_fn()
 
+    def _bounded_dispatch(self) -> bool:
+        """True when in-flight compiled executions must be bounded to one.
+
+        XLA:CPU runs every partition's thunks on one shared intra-op pool;
+        with free-running async dispatch, a later execution's thunks can be
+        queued ahead of an earlier execution's unfinished collective
+        rendezvous and starve it — the runtime aborts the process after its
+        40 s rendezvous termination timeout (observed on a 1-core host).
+        Blocking on each execution's result keeps rendezvous pairs
+        adjacent. The hazard is per-process (one shared pool per process),
+        so this keys off LOCAL device count: multi-process CPU clusters
+        with one device per process keep the pipeline, as do TPU/GPU —
+        tiny steps there are dispatch-bound and pipelining is the point
+        (BASELINE.md hard-part #5)."""
+        return (jax.default_backend() == "cpu"
+                and len(self.strategy.mesh.local_devices) > 1)
+
     def _init_loss_acc(self):
         if self._loss_acc_init_fn is None:
             self._loss_acc_init_fn = self._device_zero_fn(
@@ -492,6 +509,7 @@ class Trainer:
             # host runs ahead filling the dispatch pipeline (BASELINE.md
             # hard-part #5: tiny MNIST steps are dispatch-bound).
             eager_loss = bool(show) or cbs.has_batch_hooks
+            bounded = self._bounded_dispatch()
             loss_running = 0.0
             t_epoch = time.perf_counter()
             k = max(1, int(getattr(self.model, "steps_per_execution", 1)))
@@ -569,6 +587,8 @@ class Trainer:
                                     key_chunks[executions][j])
                 step_i += kk
                 executions += 1
+                if bounded:
+                    jax.block_until_ready(loss)
                 if eager_loss:
                     loss_val = float(loss)
                     loss_running += loss_val
@@ -620,10 +640,13 @@ class Trainer:
         # bounded pass only to discard it.
         import itertools
 
+        bounded_dispatch = self._bounded_dispatch()
         bounded = dist if steps is None else itertools.islice(iter(dist), steps)
         for xb, yb in bounded:
             metric_states, loss_acc = self._eval_step(
                 v["params"], v["state"], metric_states, loss_acc, xb, yb)
+            if bounded_dispatch:
+                jax.block_until_ready(loss_acc)
             count += 1
         if count == 0:
             raise RuntimeError("evaluate: dataset yielded no batches")
