@@ -179,9 +179,15 @@ def run_tpu_seq_sweep(lengths=(512, 1024, 2048, 4096), batch_tokens=32768,
                                r.get("tokens_per_sec_per_core"),
                            "mfu_pct": r.get("mfu_pct")}
                 except Exception as e:  # dense may OOM at large L —
-                    row = {"seq_len": L,  # that IS the data point
-                           "global_batch": b, "attention": attn,
-                           "failed": f"{type(e).__name__}: {e}"[:300]}
+                    msg = f"{type(e).__name__}: {e}"  # that IS the point
+                    cause = [ln_ for ln_ in msg.splitlines()
+                             if ("Ran out of memory" in ln_
+                                 or "RESOURCE_EXHAUSTED" in ln_
+                                 or "exceeded" in ln_.lower())]
+                    row = {"seq_len": L, "global_batch": b,
+                           "attention": attn,
+                           "failed": (cause[0].strip()[:300] if cause
+                                      else msg[:300])}
                 rows.append(row)
                 print(json.dumps(row), file=sys.stderr)
     finally:

@@ -67,9 +67,28 @@ import jax.numpy as jnp
 _T_CANDIDATES = (512, 256, 128)
 _G_CANDIDATES = (8, 4, 2, 1)
 
-#: VMEM bytes the layout estimator may plan against (16 MB physical; the
-#: slack covers q/o/lse tiles and Mosaic's own temporaries).
+#: VMEM bytes the layout estimator may plan against. The physical VMEM is
+#: 128 MB; XLA's default SCOPED limit is 16 MB, which the kernel raises via
+#: vmem_limit_bytes below — the planning budget stays deliberately tighter
+#: than the raised limit because the stack estimate undercounts Mosaic's
+#: live temporaries by a few score tiles (measured: the (g=4, t=512)
+#: L=1024 bf16 config estimates 12.6 MB but allocates 17.1 MB).
 _VMEM_BUDGET = 13 * 1024 * 1024
+
+#: Scoped-VMEM ceiling passed to Mosaic (< the 128 MB physical so XLA keeps
+#: room for its own buffers). Without this, shapes whose true footprint
+#: lands in (16, ~32] MB — e.g. the LM at seq >= 1024 — fail AOT compile
+#: with a scoped-vmem stack OOM even though the chip has 8x the memory.
+_VMEM_LIMIT = 100 * 1024 * 1024
+
+
+def _compiler_params(interpret):
+    if interpret:
+        return None
+    from jax.experimental.pallas import tpu as pltpu
+
+    cp = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cp(vmem_limit_bytes=_VMEM_LIMIT)
 
 
 def _fits(g, t, ln, d, itemsize, n_score):
@@ -193,6 +212,7 @@ def _fwd(q3, k3, v3, causal, scale, interpret, g, tq, tk):
             jax.ShapeDtypeStruct((bh, ln, 1), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q3, k3, v3)
     return o, lse
 
@@ -339,6 +359,7 @@ def _bwd(q3, k3, v3, o3, lse, g3, causal, scale, interpret, g, tq, tk):
                        jax.ShapeDtypeStruct((bh, ln, d), k3.dtype),
                        jax.ShapeDtypeStruct((bh, ln, d), v3.dtype)],
             interpret=interpret,
+            compiler_params=_compiler_params(interpret),
         )(q3, k3, v3, g3, lse, delta)
 
     dq = pl.pallas_call(
@@ -350,6 +371,7 @@ def _bwd(q3, k3, v3, o3, lse, g3, causal, scale, interpret, g, tq, tk):
         out_specs=qtile_spec,
         out_shape=jax.ShapeDtypeStruct((bh, ln, d), q3.dtype),
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q3, k3, v3, g3, lse, delta)
 
     ktile_spec = pl.BlockSpec((g, tk, d), lambda b, i: (b, i, 0),
@@ -364,6 +386,7 @@ def _bwd(q3, k3, v3, o3, lse, g3, causal, scale, interpret, g, tq, tk):
         out_shape=[jax.ShapeDtypeStruct((bh, ln, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, ln, d), v3.dtype)],
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q3, k3, v3, g3, lse, delta)
     return dq, dk, dv
 
