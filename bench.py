@@ -543,7 +543,8 @@ def run_cpu_baseline() -> dict:
 
 
 def run_scaling(mesh_sizes=(1, 2, 4, 8), global_batch: int = 128,
-                spe: int = 16, config: str = "mnist_cnn") -> dict:
+                spe: int = 16, config: str = "mnist_cnn",
+                steps: int = 32, warmup: int = 16) -> dict:
     """SPMD partition-overhead table on a virtual CPU mesh, at fixed GLOBAL
     work: the same global batch (the reference's 128, tf_dist_example.py:
     17-18) is sharded over 1/2/4/8 virtual devices that all share one
@@ -562,7 +563,7 @@ def run_scaling(mesh_sizes=(1, 2, 4, 8), global_batch: int = 128,
     for n in mesh_sizes:
         r = _run_child(["--step-child", config,
                         "--batch", str(global_batch),
-                        "--steps", "32", "--warmup", "16",
+                        "--steps", str(steps), "--warmup", str(warmup),
                         "--spe", str(spe), "--repeats", "2"], n)
         rows.append({"devices": n,
                      "global_batch": r["global_batch"],
@@ -589,10 +590,17 @@ def run_scaling_all() -> dict:
       conv cost is superlinear in per-device batch, so its 'efficiency'
       column mixes backend artifacts into the metric.
     """
+    # spe=1 for both workloads: XLA:CPU lowers the scanned multi-step body
+    # pathologically (r3: spe=8 measured 3.4 s/step vs 8x115 ms unrolled),
+    # and with per-exec sync the spe knob only adds that pathology to the
+    # thing being measured. Batch/step counts sized for a 1-core host: the
+    # LM's matmul-dominated step measures ~9 s at batch 8 there, so each
+    # mesh size costs ~3 min of the 900 s child timeout.
     return {
         "transformer_lm": run_scaling(config="transformer_lm",
-                                      global_batch=16, spe=4),
-        "mnist_cnn_conv_caveat": run_scaling(config="mnist_cnn"),
+                                      global_batch=8, spe=1, steps=8,
+                                      warmup=3),
+        "mnist_cnn_conv_caveat": run_scaling(spe=1, steps=24, warmup=8),
     }
 
 
