@@ -40,7 +40,8 @@ def _encode_value(v):
         return {"__attention__": {
             "class": "RingAttention",
             "config": {k: getattr(v, k)
-                       for k in ("axis_name", "batch_axis", "scale")}}}
+                       for k in ("axis_name", "batch_axis", "scale",
+                                 "kv_chunk")}}}
     if isinstance(v, (tuple, list)):
         return [_encode_value(e) for e in v]
     if callable(v):

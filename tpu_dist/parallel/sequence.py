@@ -68,8 +68,17 @@ def _mark_varying(x, axes):
         return jax.lax.pvary(x, axes)
 
 
+#: Within-shard K/V chunking threshold/size: shards longer than the
+#: threshold fold their block in C-sized chunks via an inner scan, so the
+#: live score temp is [B, H, Lc, C] instead of [B, H, Lc, Lc]. 2048 keeps
+#: the matmuls MXU-sized while cutting the dominant temp Lc/C-fold.
+_KV_CHUNK_AUTO_THRESHOLD = 4096
+_KV_CHUNK_DEFAULT = 2048
+
+
 def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int,
-                          varying_axes: tuple, causal: bool, scale: float):
+                          varying_axes: tuple, causal: bool, scale: float,
+                          kv_chunk: Optional[int]):
     """Per-shard body (runs under shard_map): full-context attention for this
     device's query block, K/V shards rotating around ``axis_name``.
 
@@ -79,8 +88,31 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int,
     b, h, lc, d = q.shape
     qf = q.astype(jnp.float32) * scale
 
+    if kv_chunk is not None and (kv_chunk <= 0 or lc % kv_chunk):
+        kv_chunk = None  # indivisible/degenerate: fall through to auto
+    if kv_chunk is None and lc > _KV_CHUNK_AUTO_THRESHOLD:
+        # Auto-chunk long shards (also the fallback for an indivisible
+        # explicit kv_chunk — silently losing chunking at exactly the
+        # sizes a user reaches for it would invite the OOM they were
+        # avoiding). _KV_CHUNK_DEFAULT divides any power-of-two lc above
+        # the threshold; for non-power-of-two lc it only applies if it
+        # divides.
+        if lc % _KV_CHUNK_DEFAULT == 0:
+            kv_chunk = _KV_CHUNK_DEFAULT
+
     # Global positions of this device's queries / of a kv shard from source s.
     q_pos = my_idx * lc + jnp.arange(lc)  # [Lc]
+
+    def fold(m, l, acc, k_blk, v_blk, kv_start):
+        """One online-softmax fold of q against a K/V slab whose global
+        positions begin at ``kv_start``."""
+        scores = jnp.einsum("...qd,...kd->...qk", qf,
+                            k_blk.astype(jnp.float32))
+        if causal:
+            kv_pos = kv_start + jnp.arange(k_blk.shape[2])
+            mask = q_pos[:, None] >= kv_pos[None, :]  # [Lq, Lk]
+            scores = jnp.where(mask, scores, -jnp.inf)
+        return _online_merge(m, l, acc, scores, v_blk)
 
     def step(carry, t):
         m, l, acc, k_cur, v_cur = carry
@@ -90,13 +122,24 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int,
 
         def consume(mla):
             m, l, acc = mla
-            scores = jnp.einsum("...qd,...kd->...qk", qf,
-                                k_cur.astype(jnp.float32))
-            if causal:
-                kv_pos = src * lc + jnp.arange(lc)  # [Lc]
-                mask = q_pos[:, None] >= kv_pos[None, :]  # [Lq, Lk]
-                scores = jnp.where(mask, scores, -jnp.inf)
-            return _online_merge(m, l, acc, scores, v_cur)
+            if kv_chunk is None:
+                return fold(m, l, acc, k_cur, v_cur, src * lc)
+
+            # Long shard: fold in C-chunks via an inner (checkpointed)
+            # scan, bounding the live score temp to [B, H, Lc, C]. No
+            # chunk of this block is ever fully masked for causal
+            # self-attention (future SOURCES are skipped below), so no
+            # per-chunk dead-block cond is needed.
+            def chunk_step(mla, j):
+                m, l, acc = mla
+                k_blk = jax.lax.dynamic_slice_in_dim(
+                    k_cur, j * kv_chunk, kv_chunk, axis=2)
+                v_blk = jax.lax.dynamic_slice_in_dim(
+                    v_cur, j * kv_chunk, kv_chunk, axis=2)
+                return fold(m, l, acc, k_blk, v_blk,
+                            src * lc + j * kv_chunk), None
+            return jax.lax.scan(jax.checkpoint(chunk_step), (m, l, acc),
+                                jnp.arange(lc // kv_chunk))[0]
 
         if causal:
             # A shard from a strictly-future source is entirely masked:
@@ -142,7 +185,8 @@ def _ring_attention_shard(q, k, v, *, axis_name: str, axis_size: int,
 
 def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS,
                    causal: bool = False, scale: Optional[float] = None,
-                   batch_axis: Optional[str] = None):
+                   batch_axis: Optional[str] = None,
+                   kv_chunk: Optional[int] = None):
     """Exact multi-head attention over a sequence-sharded context.
 
     Args:
@@ -154,6 +198,12 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS,
       scale: score scale; default 1/sqrt(D).
       batch_axis: optional mesh axis sharding the batch dimension (combine
         sequence parallelism with data parallelism).
+      kv_chunk: fold each ring step's K/V shard in chunks of this many
+        positions (inner checkpointed scan), bounding the live score temp
+        to ``[B, H, Lc, kv_chunk]`` instead of ``[B, H, Lc, Lc]``. Default
+        None auto-chunks at 2048 when the per-device shard exceeds 4096;
+        pass a value to force or widen it (must divide Lc — an
+        indivisible value falls back to the auto policy).
 
     Returns:
       [B, H, L, D] attention output, sequence-sharded like q.
@@ -188,7 +238,8 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS,
     varying = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
     body = functools.partial(
         _ring_attention_shard, axis_name=axis_name, axis_size=axis_size,
-        varying_axes=varying, causal=causal, scale=scale)
+        varying_axes=varying, causal=causal, scale=scale,
+        kv_chunk=kv_chunk)
     fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                    out_specs=spec)
     return fn(q, k, v)
@@ -217,6 +268,7 @@ class RingAttention:
     axis_name: str = SEQ_AXIS
     batch_axis: Optional[str] = None
     scale: Optional[float] = None
+    kv_chunk: Optional[int] = None
     mesh: Optional[Mesh] = None
 
     def resolve_mesh(self) -> Mesh:
@@ -238,7 +290,8 @@ class RingAttention:
     def __call__(self, q, k, v, *, causal: bool = False):
         return ring_attention(
             q, k, v, mesh=self.resolve_mesh(), axis_name=self.axis_name,
-            causal=causal, scale=self.scale, batch_axis=self.batch_axis)
+            causal=causal, scale=self.scale, batch_axis=self.batch_axis,
+            kv_chunk=self.kv_chunk)
 
 
 def sequence_sharding(mesh: Mesh, *, axis_name: str = SEQ_AXIS,
