@@ -24,6 +24,10 @@ from tpu_dist.parallel.sequence import (
     ring_attention,
     sequence_sharding,
 )
+from tpu_dist.parallel.tensor import (
+    MODEL_AXIS,
+    tensor_parallel_specs,
+)
 from tpu_dist.parallel.strategy import (
     DefaultStrategy,
     InputContext,
@@ -51,9 +55,11 @@ __all__ = [
     "host_all_reduce_sum",
     "set_collective_logging",
     "SEQ_AXIS",
+    "MODEL_AXIS",
     "RingAttention",
     "ring_attention",
     "sequence_sharding",
+    "tensor_parallel_specs",
     "DefaultStrategy",
     "InputContext",
     "MirroredStrategy",

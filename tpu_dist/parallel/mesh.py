@@ -153,18 +153,30 @@ def replicate(tree, mesh, *, broadcast: bool = False):
     """
     import jax
 
+    sharding = replicated(mesh)
+    return place_with_shardings(
+        tree, jax.tree_util.tree_map(lambda _: sharding, tree),
+        broadcast=broadcast)
+
+
+def place_with_shardings(tree, shardings, *, broadcast: bool = False):
+    """Place a pytree with a PER-LEAF NamedSharding tree (replicated
+    mirrors, tensor-parallel shards, or a mix). With ``broadcast=True`` in
+    a multi-process job, process 0's values are broadcast first so every
+    process starts identical (SURVEY.md D4)."""
+    import jax
+
     if broadcast and jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         tree = multihost_utils.broadcast_one_to_all(tree)
 
-    sharding = replicated(mesh)
-
-    def _place(x):
+    def _place(x, sharding):
         x = np.asarray(x)
         # make_array_from_callback only asks each process for its addressable
         # shards, so this single code path is multi-process safe (device_put to
         # non-addressable devices is not).
-        return jax.make_array_from_callback(x.shape, sharding, lambda idx: x[idx])
+        return jax.make_array_from_callback(x.shape, sharding,
+                                            lambda idx: x[idx])
 
-    return jax.tree_util.tree_map(_place, tree)
+    return jax.tree_util.tree_map(_place, tree, shardings)

@@ -133,6 +133,47 @@ class Strategy:
         """Replicated — MirroredVariable semantics (SURVEY.md D4)."""
         return mesh_lib.replicated(self._mesh)
 
+    @property
+    def model_parallel(self) -> bool:
+        """True when the mesh carries a ``'model'`` axis of size > 1 —
+        variables then shard Megatron-style instead of mirroring
+        (parallel/tensor.py)."""
+        from tpu_dist.parallel import tensor
+
+        return self._mesh.shape.get(tensor.MODEL_AXIS, 1) > 1
+
+    def param_spec_tree(self, params):
+        """PartitionSpec tree for a params tree: tensor-parallel rules when
+        the mesh has a ``'model'`` axis, else replicated everywhere."""
+        from jax.sharding import PartitionSpec
+        from tpu_dist.parallel import tensor
+
+        if self.model_parallel:
+            return tensor.tensor_parallel_specs(params)
+        import jax
+
+        return jax.tree_util.tree_map(lambda _: PartitionSpec(), params)
+
+    def variable_shardings(self, params, tree):
+        """NamedSharding tree for ANY variables tree (params themselves,
+        optimizer moments, ...) — leaves inherit the matching param's spec
+        by path suffix; unmatched leaves replicate (parallel/tensor.py)."""
+        from tpu_dist.parallel import tensor
+
+        specs = tensor.specs_like_params(tree, self.param_spec_tree(params))
+        specs = tensor.prune_indivisible(specs, tree, self._mesh)
+        return tensor.shardings_from_specs(specs, self._mesh)
+
+    def place_variables(self, params, tree, *, broadcast: bool | None = None):
+        """Place a variables tree with per-leaf shardings derived from the
+        params rules; the TP-aware generalization of :meth:`replicate`."""
+        import jax
+
+        if broadcast is None:
+            broadcast = jax.process_count() > 1
+        return mesh_lib.place_with_shardings(
+            tree, self.variable_shardings(params, tree), broadcast=broadcast)
+
     def batch_sharding(self):
         """Leading dim split across the data axis (SURVEY.md D14)."""
         return mesh_lib.batch_sharded(self._mesh, self.data_axis)

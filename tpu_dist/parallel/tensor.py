@@ -1,0 +1,144 @@
+"""Tensor (model) parallelism: Megatron-style sharding rules over a
+``'model'`` mesh axis, applied as GSPMD sharding annotations.
+
+The reference framework scales by data parallelism only (SURVEY.md §2.3);
+this module is the tensor-parallel axis, built the TPU way: **no new
+collective code**. Rules map each parameter to a
+``jax.sharding.PartitionSpec`` and XLA's SPMD partitioner derives every
+all-reduce/all-gather from the sharded matmuls themselves — the same
+division of labor as the DP design (SURVEY.md §5.8), now along the
+feature dimension:
+
+* attention QKV projections are column-parallel (heads split over
+  ``'model'``: ``[d, H*dk]`` → ``P(None, 'model')``), the output
+  projection row-parallel (``[H*dk, d]`` → ``P('model', None)``) — one
+  partial-sum all-reduce per attention block, inserted by XLA;
+* MLP up-projection column-parallel, down-projection row-parallel —
+  one all-reduce per MLP;
+* the vocab head is column-parallel (vocab split), so logits stay
+  sharded and the loss's log-sum-exp reduces across the axis in-place;
+* everything else (LayerNorm, embeddings, convs, biases of row-parallel
+  layers) is replicated.
+
+Because these are ANNOTATIONS, wrong-but-well-typed rules can never
+corrupt math — GSPMD inserts whatever communication correctness needs —
+so the rules are a performance contract, and the tests pin numerical
+equality against the replicated baseline.
+
+Composition: the ``'data'`` axis keeps sharding the batch (hybrid
+DP x TP on one mesh); optimizer/momentum trees inherit each parameter's
+spec by path suffix, so Adam's ``mu``/``nu`` shard exactly like the
+parameter they track.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MODEL_AXIS = "model"
+
+#: Column-parallel attention projections (output dim = heads * key_dim).
+_ATTN_COL_W = ("wq", "wk", "wv")
+_ATTN_COL_B = ("bq", "bk", "bv")
+
+
+def _dict_path_names(path) -> list[str]:
+    return [p.key for p in path
+            if isinstance(p, jax.tree_util.DictKey)]
+
+
+def _base(name: str) -> str:
+    """Layer-name key without the uniquing suffix: dense_1 -> dense."""
+    head, _, tail = name.rpartition("_")
+    return head if head and tail.isdigit() else name
+
+
+def _dense_is_column(layer_name: str) -> bool:
+    """Within one layer chain, alternate Dense layers column/row: the
+    uniquing suffix (dense, dense_1, dense_2, ...) gives the position.
+    Even positions (up-projections, heads) are column-parallel; odd
+    (down-projections back to the residual stream) row-parallel. This
+    matches TransformerBlock's MLP (dense=up, dense_1=down) and makes a
+    standalone head (plain 'dense') column-parallel."""
+    _, _, tail = layer_name.rpartition("_")
+    idx = int(tail) if tail.isdigit() else 0
+    return idx % 2 == 0
+
+
+def spec_for_param(path, leaf, *, axis_name: str = MODEL_AXIS) -> P:
+    """Megatron-style PartitionSpec for one parameter, by its tree path."""
+    names = _dict_path_names(path)
+    if len(names) < 2:
+        return P()
+    layer, pname = _base(names[-2]), names[-1]
+    if layer == "multiheadattention":
+        if pname in _ATTN_COL_W:
+            return P(None, axis_name)
+        if pname in _ATTN_COL_B:
+            return P(axis_name)
+        if pname == "wo":
+            return P(axis_name, None)
+        return P()  # bo: row-parallel output bias is replicated
+    if layer == "dense" and getattr(leaf, "ndim", 0) in (1, 2):
+        if _dense_is_column(names[-2]):
+            return (P(None, axis_name) if leaf.ndim == 2
+                    else P(axis_name))
+        return P(axis_name, None) if leaf.ndim == 2 else P()
+    return P()
+
+
+def tensor_parallel_specs(params, *, axis_name: str = MODEL_AXIS):
+    """PartitionSpec tree for a params tree (shape mirrors ``params``)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for_param(path, leaf, axis_name=axis_name),
+        params)
+
+
+def specs_like_params(tree, params_specs) -> Any:
+    """Map an arbitrary variables tree (optimizer moments, velocity, ...)
+    onto the params' specs by PATH SUFFIX: optimizer states embed the
+    params tree verbatim (e.g. AdamState.mu[...same path...]), so a leaf
+    whose trailing path components equal some param's full path inherits
+    that param's spec. Everything else (step counters, scalars) is
+    replicated."""
+    flat_params = jax.tree_util.tree_flatten_with_path(params_specs)[0]
+    by_suffix = {tuple(_dict_path_names(path)): spec
+                 for path, spec in flat_params}
+
+    def lookup(path, leaf):
+        names = tuple(_dict_path_names(path))
+        for start in range(len(names)):
+            spec = by_suffix.get(names[start:])
+            if spec is not None and len(spec) <= getattr(leaf, "ndim", 0):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(lookup, tree)
+
+
+def prune_indivisible(specs, tree, mesh: Mesh):
+    """Replace any spec whose sharded dimension doesn't divide evenly by
+    the mesh axis with replicated. Explicit placement (NamedSharding)
+    requires even tiling; an odd vocabulary or head count should degrade
+    to mirroring that leaf, not crash the job."""
+    def check(spec, leaf):
+        shape = getattr(leaf, "shape", ())
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            if dim >= len(shape) or shape[dim] % mesh.shape[axis]:
+                return P()
+        return spec
+
+    return jax.tree_util.tree_map(
+        check, specs, tree, is_leaf=lambda x: isinstance(x, P))
+
+
+def shardings_from_specs(specs, mesh: Mesh):
+    """Spec tree -> NamedSharding tree on ``mesh``."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))

@@ -198,7 +198,12 @@ def restore_model(directory: str | os.PathLike, model, *,
     v = trainer.variables
     template = {k: v[k] for k in ("params", "state", "opt") if k in v}
     host, step = restore(directory, template, step=step)
-    placed = trainer.strategy.replicate(host, broadcast=False)
+    # Strategy-owned placement: mirrored on a data mesh, Megatron shards
+    # under a 'model' axis — a TP job must NOT come back replicated (it
+    # would multiply per-device param+moment memory by the model-axis size
+    # and force a reshard on the first step).
+    placed = trainer.strategy.place_variables(host["params"], host,
+                                              broadcast=False)
     for k in template:
         v[k] = placed[k]
     return step

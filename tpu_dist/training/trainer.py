@@ -119,9 +119,12 @@ class Trainer:
                 "opt": self.model.optimizer.init(model_vars["params"])
                 if self.model.optimizer else (),
             }
-        # Replicate onto the mesh; multi-process jobs broadcast process 0's
+        # Place onto the mesh; multi-process jobs broadcast process 0's
         # values so every replica starts identical (SURVEY.md D4, §3.2).
-        placed = self.strategy.replicate(host)
+        # The strategy owns the per-leaf policy: mirrored everywhere on a
+        # data(/seq) mesh, Megatron shards for params/optimizer under a
+        # 'model' axis (parallel/tensor.py).
+        placed = self.strategy.place_variables(host["params"], host)
         placed["metrics"] = self._init_metric_states()
         self.variables = placed
         n_params = sum(x.size for x in jax.tree_util.tree_leaves(
@@ -235,8 +238,10 @@ class Trainer:
 
         v = self.variables
         acc = self._init_loss_acc()
-        return (None, rep_like(v["params"]), rep_like(v["state"]),
-                rep_like(v["opt"]), rep_like(v["metrics"]), rep_like(acc))
+        p_sh = self.strategy.variable_shardings(v["params"], v["params"])
+        o_sh = self.strategy.variable_shardings(v["params"], v["opt"])
+        return (None, p_sh, rep_like(v["state"]),
+                o_sh, rep_like(v["metrics"]), rep_like(acc))
 
     def _build_train_step(self):
         return jax.jit(
