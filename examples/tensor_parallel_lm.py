@@ -1,0 +1,60 @@
+"""Hybrid data x tensor parallel training of the transformer LM.
+
+Beyond the reference's parity scope (it is DP-only, SURVEY.md §5.7); this
+demonstrates tpu_dist's tensor-parallel axis (`parallel/tensor.py`): add a
+``'model'`` axis to the mesh and the SAME ``compile``/``fit`` program
+shards its attention and MLP parameters Megatron-style across it —
+column-parallel QKV and MLP-up, row-parallel output projections — with
+XLA's SPMD partitioner deriving the per-block all-reduces from the sharded
+matmuls. No model or training-loop changes: the strategy's ``axis_shapes``
+is the entire opt-in.
+
+What to look at after fit():
+* parameter leaves really are 1/M-sharded per device (`.sharding.spec`
+  and `.addressable_shards`), as are Adam's moments;
+* losses are numerically identical to the replicated data-parallel run
+  (tests/test_tensor_parallel.py pins this) — sharding is placement, not
+  math.
+
+Run single-host (8 virtual devices), from the repo root:
+    PYTHONPATH=. JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/tensor_parallel_lm.py
+Multi-host: same per-worker TF_CONFIG launch as examples/tpu_dist_example.py
+(the mesh then spans hosts; 'model' stays intra-host for ICI-speed
+all-reduces when axis_shapes is ordered data-outermost, as here).
+"""
+
+import numpy as np
+
+import tpu_dist as td
+from tpu_dist.models.transformer import build_transformer_lm
+
+VOCAB, SEQ = 512, 128
+
+# data(2) x model(4): batches shard 2 ways, every layer's heads/hidden
+# shard 4 ways. axis_shapes must include 'data' (batches ride it).
+strategy = td.MirroredStrategy(axis_shapes={"data": 2, "model": 4})
+
+with strategy.scope():
+    model = build_transformer_lm(VOCAB, SEQ, d_model=128, depth=2,
+                                 num_heads=8)
+    model.compile(
+        loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+        optimizer=td.ops.Adam(1e-3),
+        metrics=["accuracy"],
+    )
+
+# Synthetic next-token data (any td.data pipeline works here).
+rng = np.random.default_rng(0)
+stream = rng.integers(0, VOCAB, size=4096 + SEQ + 1).astype(np.int64)
+xs = np.stack([stream[i:i + SEQ] for i in range(0, 4096, 32)])
+ys = np.stack([stream[i + 1:i + SEQ + 1] for i in range(0, 4096, 32)])
+ds = td.data.Dataset.from_tensor_slices((xs, ys)).batch(16).repeat()
+
+model.fit(ds, epochs=2, steps_per_epoch=8, verbose=1)
+
+wq = model.variables["params"]["block"]["residual"]["main"][
+    "multiheadattention"]["wq"]
+print(f"wq: global {wq.shape}, spec {wq.sharding.spec}, "
+      f"per-device shard {wq.addressable_shards[0].data.shape}")
