@@ -32,6 +32,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from tpu_dist.models.layers import Block, Dense, Layer, Residual
 from tpu_dist.ops import initializers
@@ -111,14 +112,79 @@ def _dense_attention(q, k, v, *, causal: bool, scale: float):
                       v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _mesh_mapped_flash(q, *, causal: bool, scale: float,
+                       interpret: bool | None = None):
+    """shard_map'd flash attention over the active strategy's mesh, or
+    None when inapplicable.
+
+    The fused kernel's custom call is opaque to XLA's SPMD partitioner:
+    left unwrapped on a >1-device mesh, GSPMD all-gathers the sharded
+    q/k/v around it and every device recomputes the GLOBAL batch's
+    attention — silently, in the most common distributed configurations.
+    Batch entries and heads are independent attention instances, so
+    mapping the kernel per data-shard (batch dim) and per model-shard
+    (head dim) is exact — the same composition the ring path uses for its
+    seq axis. Declines (returns None) when: no strategy scope / 1-device
+    mesh; a mesh axis is already bound (e.g. applied inside
+    ``strategy.run`` — binding it twice would raise); no divisible
+    data/model axis; or the per-shard shape is outside the kernel's
+    envelope."""
+    from tpu_dist.ops import flash_attention as fa
+    from tpu_dist.parallel import mesh as mesh_lib
+    from tpu_dist.parallel.strategy import get_strategy, has_strategy
+
+    if q.ndim != 4 or not has_strategy():
+        return None
+    strategy = get_strategy()
+    mesh = strategy.mesh
+    if mesh.devices.size <= 1 or mesh_lib.inside_manual_axes(mesh):
+        return None
+    b, h, _, _ = q.shape
+
+    def usable(axis, dim):
+        size = mesh.shape.get(axis, 1)
+        return axis if size > 1 and dim % size == 0 else None
+
+    d_axis = usable(strategy.data_axis, b)
+    m_axis = usable(mesh_lib.MODEL_AXIS, h)
+    if d_axis is None and m_axis is None:
+        return None
+    d_size = mesh.shape.get(d_axis, 1)
+    m_size = mesh.shape.get(m_axis, 1)
+    # The kernel must support the PER-SHARD shape.
+    shard = jax.ShapeDtypeStruct((b // d_size, h // m_size, *q.shape[2:]),
+                                 q.dtype)
+    if not fa.supported(shard):
+        return None
+
+    shard_map = mesh_lib.get_shard_map()
+    spec = P(d_axis, m_axis, None, None)
+    body = functools.partial(fa.flash_attention, causal=causal, scale=scale,
+                             interpret=interpret)
+    try:
+        # pallas_call's out_shape carries no varying-mesh-axes type, so the
+        # vma checker can't see through the custom call; the body is
+        # per-shard pure, which is exactly what disabling the check asserts.
+        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_rep=False)
+
+
 def _default_attention(q, k, v, *, causal: bool, scale: float):
     """Single-device attention dispatch: the fused flash kernel
     (ops/flash_attention.py) on TPU for supported shapes — O(L) memory,
-    tiled online softmax — else the dense reference path. TPU_DIST_FLASH=0
-    forces dense for A/B measurement."""
+    tiled online softmax; on a >1-device mesh the kernel maps per
+    data/model shard via shard_map (batch entries and heads are
+    independent) — else the dense reference path, which GSPMD partitions
+    natively. TPU_DIST_FLASH=0 forces dense for A/B measurement."""
     from tpu_dist.ops import flash_attention as fa
 
     if fa.use_flash(q):
+        mapped = _mesh_mapped_flash(q, causal=causal, scale=scale)
+        if mapped is not None:
+            return mapped(q, k, v)
         return fa.flash_attention(q, k, v, causal=causal, scale=scale)
     return _dense_attention(q, k, v, causal=causal, scale=scale)
 

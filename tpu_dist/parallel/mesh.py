@@ -30,6 +30,32 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 
 
+def get_shard_map():
+    """The shard_map entry point across jax generations (moved from
+    jax.experimental to the top level in jax 0.8) — one shim for every
+    call site."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def inside_manual_axes(mesh) -> bool:
+    """True when any of ``mesh``'s axis names is already bound in the
+    current trace (i.e. we are inside a shard_map over it — e.g. a model
+    applied within ``strategy.run``): binding the same axis twice raises,
+    so callers use this to decline nested mappings. Conservative: if the
+    axis environment can't be read, report True (decline)."""
+    try:
+        from jax._src.core import get_axis_env
+
+        bound = set(get_axis_env().axis_sizes)
+    except Exception:  # pragma: no cover - jax internals moved
+        return True
+    return bool(bound & set(mesh.axis_names))
+
+
 def make_mesh(axis_shapes: Mapping[str, int] | None = None,
               *, devices: Sequence | None = None,
               local: bool = False):

@@ -212,11 +212,9 @@ def ring_attention(q, k, v, *, mesh: Mesh, axis_name: str = SEQ_AXIS,
     ``softmax(q k^T * scale [+ causal mask]) v`` on the gathered arrays —
     asserted by tests/test_sequence.py against the dense reference.
     """
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover
-        from jax.experimental.shard_map import shard_map
+    from tpu_dist.parallel.mesh import get_shard_map
 
+    shard_map = get_shard_map()
     axis_size = mesh.shape[axis_name]
     # Self-attention contract (ADVICE r2): the causal kv_pos computation
     # derives K/V global positions from q's per-shard length, so a K/V with

@@ -366,10 +366,8 @@ class Strategy:
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
-        try:
-            from jax import shard_map  # jax >= 0.8
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
+
+        shard_map = mesh_lib.get_shard_map()
 
         def body(*leaves):
             a, k = jax.tree.unflatten(treedef, leaves)

@@ -38,7 +38,7 @@ from typing import Any
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MODEL_AXIS = "model"
+from tpu_dist.parallel.mesh import MODEL_AXIS
 
 #: Column-parallel attention projections (output dim = heads * key_dim).
 _ATTN_COL_W = ("wq", "wk", "wv")
