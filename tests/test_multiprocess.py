@@ -346,3 +346,58 @@ emit({"process_index": jax.process_index(), "dead": [], "error": None})
         assert r0.result["dead"] == [1], r0.result
         assert r0.result["error"] is not None, r0.result
         assert "Restart" in r0.result["error"], r0.result
+
+
+class TestTensorParallelMultiProcess:
+    def test_hybrid_dp_tp_across_processes(self):
+        # The realistic TP topology: 'model' axis intra-process (the ICI
+        # analog), 'data' axis spanning the two real processes (the DCN
+        # analog). Both workers fit the same LM; losses must be identical
+        # on every process and each process's local devices must hold
+        # 1/4-width Megatron shards of the attention projections.
+        body = """
+import numpy as np
+import jax
+import tpu_dist as td
+from jax.sharding import PartitionSpec as P
+from tpu_dist.models.transformer import build_transformer_lm
+
+td.cluster.initialize()
+assert jax.process_count() == 2 and jax.local_device_count() == 4
+strategy = td.MultiWorkerMirroredStrategy(
+    axis_shapes={"data": 2, "model": 4})
+assert strategy.num_replicas_in_sync == 2
+
+VOCAB, SEQ = 32, 16
+seq = np.arange(256) * 3 % VOCAB
+xs = np.stack([seq[i:i + SEQ] for i in range(0, 192, 4)]).astype(np.int64)
+ys = np.stack([seq[i + 1:i + SEQ + 1]
+               for i in range(0, 192, 4)]).astype(np.int64)
+ds = td.data.Dataset.from_tensor_slices((xs, ys)).batch(16).repeat()
+
+with strategy.scope():
+    model = build_transformer_lm(VOCAB, SEQ, d_model=32, depth=1,
+                                 num_heads=4)
+    model.compile(
+        loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+        optimizer=td.ops.Adam(1e-2), metrics=["accuracy"])
+    hist = model.fit(ds, epochs=2, steps_per_epoch=3, verbose=0)
+
+wq = model.variables["params"]["block"]["residual"]["main"][
+    "multiheadattention"]["wq"]
+assert wq.sharding.spec == P(None, "model"), wq.sharding.spec
+local_shapes = sorted(s.data.shape for s in wq.addressable_shards)
+emit({"process_index": jax.process_index(),
+      "losses": [float(l) for l in hist.history["loss"]],
+      "wq_local_shapes": [list(s) for s in local_shapes]})
+"""
+        results = run_workers(
+            body, num_workers=2,
+            extra_env={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=4"})
+        assert_all_succeeded(results)
+        l0, l1 = (r.result["losses"] for r in results)
+        assert l0 == l1, (l0, l1)
+        for r in results:
+            # 4 local devices, each holding a distinct 32x8 column shard
+            assert r.result["wq_local_shapes"] == [[32, 8]] * 4, r.result

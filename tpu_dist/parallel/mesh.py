@@ -77,7 +77,17 @@ def make_mesh(axis_shapes: Mapping[str, int] | None = None,
 
     if devices is None:
         devices = jax.local_devices() if local else jax.devices()
-    devices = list(devices)
+        # Process-contiguous ordering: jax.devices()'s global order is not
+        # guaranteed process-contiguous on every multi-host topology, but
+        # a trailing mesh axis only stays intra-host (ICI-speed
+        # collectives) if each outer-axis row is one process's block.
+        # Sorting by (process_index, id) makes the row-major reshape below
+        # put inner axes within a process whenever the sizes align (e.g.
+        # {'data': n_processes, 'model': n_local}).
+        devices = sorted(devices,
+                         key=lambda d: (d.process_index, d.id))
+    else:
+        devices = list(devices)
     if not devices:
         raise ValueError("no devices available for mesh construction")
 

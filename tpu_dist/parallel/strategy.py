@@ -455,12 +455,19 @@ class MultiWorkerMirroredStrategy(Strategy):
 
     def __init__(self,
                  communication: CollectiveCommunication | str | None = None,
-                 cluster_config=None):
+                 cluster_config=None,
+                 axis_shapes: Optional[dict] = None):
         import jax
 
         self.communication = CollectiveCommunication.resolve(communication)
         bootstrap.initialize(config=cluster_config)
-        super().__init__()  # all global devices
+        # axis_shapes carves the GLOBAL device set into extra mesh axes
+        # (seq/model/...) exactly as on MirroredStrategy — e.g.
+        # {'data': n_processes, 'model': local_devices} keeps the model
+        # axis intra-host (ICI-speed all-reduces) with data across hosts:
+        # make_mesh orders devices process-contiguously, so inner axes
+        # land within a process when the sizes align.
+        super().__init__(axis_shapes=axis_shapes)  # all global devices
         bootstrap.barrier("MultiWorkerMirroredStrategy_init")
         # Peer-health monitoring starts only after the startup barrier, so it
         # can't fire during bring-up (tf:...collective_all_reduce_strategy.py:
