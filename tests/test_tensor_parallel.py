@@ -211,3 +211,35 @@ class TestModelParallelFlash:
         with td.MirroredStrategy().scope() as s:
             s.run(step, (jnp.zeros((8, 4)),))
         assert seen and all(m is None for m in seen)
+
+
+class TestTensorParallelMixedPrecision:
+    def test_tp_with_bf16_policy(self, eight_devices):
+        # The TPU-native recipe (mixed_bfloat16) composed with the model
+        # axis: params stay fp32 AND sharded, training runs, loss finite,
+        # evaluate works on the sharded variables.
+        from tpu_dist.models.policy import set_policy
+
+        set_policy("mixed_bfloat16")
+        try:
+            strategy = td.MirroredStrategy(
+                axis_shapes={"data": 2, "model": 4})
+            with strategy.scope():
+                model = build_transformer_lm(VOCAB, SEQ, d_model=32,
+                                             depth=1, num_heads=4)
+                model.compile(
+                    loss=td.ops.SparseCategoricalCrossentropy(
+                        from_logits=True),
+                    optimizer=td.ops.Adam(1e-2), metrics=["accuracy"])
+                ds, xs = _lm_dataset()
+                hist = model.fit(ds, epochs=1, steps_per_epoch=3,
+                                 verbose=0)
+                assert np.isfinite(hist.history["loss"][-1])
+                wq = model.variables["params"]["block"]["residual"][
+                    "main"]["multiheadattention"]["wq"]
+                assert wq.dtype == np.float32  # params stay fp32
+                assert wq.sharding.spec == P(None, "model")
+                logs = model.evaluate(ds, steps=2, verbose=0)
+                assert np.isfinite(logs["loss"])
+        finally:
+            set_policy("float32")
