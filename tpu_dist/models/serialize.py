@@ -185,8 +185,10 @@ def model_config(model) -> dict:
 
 def save_model(model, directory) -> None:
     """Architecture (model.json, chief-only write) + weights (checkpoint
-    step 0). Safe in multi-process jobs: non-chief processes write nothing
-    but participate in nothing either — saving has no collective."""
+    step 0). Safe in multi-process jobs: non-chief processes write nothing,
+    but every process MUST call this — checkpoint.save ends in a barrier,
+    and when variables carry model-sharded (tensor-parallel) leaves it also
+    allgathers them across processes, both collectives all peers join."""
     from tpu_dist.cluster import bootstrap
     from tpu_dist.models.model import Sequential
     from tpu_dist.training import checkpoint

@@ -31,11 +31,44 @@ _MANIFEST = "manifest.json"
 _ARRAYS = "arrays.npz"
 
 
+def _to_host(leaf) -> np.ndarray:
+    """Fetch a leaf's GLOBAL value to host memory.
+
+    Replicated or single-process leaves read locally; a model-sharded leaf in
+    a multi-process job spans non-addressable devices, so ``np.asarray`` would
+    raise — those are allgathered across processes first. The gather is a
+    COLLECTIVE: every process must reach it (callers hoist flattening out of
+    chief-only branches; the addressability predicate is uniform across
+    processes because it is a property of the one global array)."""
+    if isinstance(leaf, jax.Array) and not (
+            leaf.is_fully_addressable or leaf.is_fully_replicated):
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+    return np.asarray(leaf)
+
+
+def _placeholder(leaf) -> np.ndarray:
+    """Host array with a leaf's global shape/dtype and arbitrary contents —
+    for templates whose values are about to be overwritten. ``jax.Array.shape``
+    is the global shape, so no collective and no device transfer happens."""
+    if isinstance(leaf, jax.Array):
+        return np.zeros(leaf.shape, leaf.dtype)
+    return np.asarray(leaf)
+
+
+def _needs_gather(tree) -> bool:
+    return any(
+        isinstance(l, jax.Array) and not (
+            l.is_fully_addressable or l.is_fully_replicated)
+        for l in jax.tree_util.tree_leaves(tree))
+
+
 def _flatten(tree) -> dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = jax.tree_util.keystr(path)
-        flat[key] = np.asarray(leaf)
+        flat[key] = _to_host(leaf)
     return flat
 
 
@@ -76,6 +109,16 @@ def save(directory: str | os.PathLike, model_or_variables, *, step: int,
                 if k in variables}
     directory = pathlib.Path(directory)
     path = None
+    # Tensor-parallel leaves require a cross-process allgather (a collective),
+    # so non-chief processes must JOIN each gather — but only the gathers:
+    # they walk the same leaf order the chief's _flatten does and discard the
+    # results, paying nothing for replicated leaves. Pure-DP saves keep their
+    # old shape (chief-only host copy, peers untouched).
+    if _needs_gather(saveable) and not bootstrap.is_chief():
+        for leaf in jax.tree_util.tree_leaves(saveable):
+            if isinstance(leaf, jax.Array) and not (
+                    leaf.is_fully_addressable or leaf.is_fully_replicated):
+                _to_host(leaf)
     if bootstrap.is_chief():
         directory.mkdir(parents=True, exist_ok=True)
         target = _step_dir(directory, step)
@@ -164,7 +207,11 @@ def restore(directory: str | os.PathLike, template: Any, *,
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     target = _step_dir(directory, step)
-    host_template = jax.tree_util.tree_map(np.asarray, template)
+    # The template's VALUES are never read — the chief overwrites every leaf
+    # from the npz and peers receive the broadcast — so sharded leaves (a TP
+    # job's live variables) become zero placeholders of their GLOBAL shape
+    # rather than paying a cross-process allgather per leaf.
+    host_template = jax.tree_util.tree_map(_placeholder, template)
     if bootstrap.process_index() == 0:
         with np.load(target / _ARRAYS) as z:
             arrays = {k: z[k] for k in z.files}
