@@ -67,6 +67,31 @@ class TestSpecRules:
         assert specs["embedding"]["table"] == P()
         assert specs["layernormalization"]["gamma"] == P()
 
+    def test_dense_roles_follow_structural_position(self):
+        # An extra Dense ahead of a block shifts the model-global uniquing
+        # counter (dense -> block's MLP becomes dense_1/dense_2). Roles
+        # must come from position WITHIN the owning chain, not counter
+        # parity (ADVICE r3): the MLP's first Dense stays column-parallel,
+        # its second row-parallel, wherever the counter starts.
+        import numpy as np
+
+        z = lambda *s: np.zeros(s, np.float32)
+        params = {
+            "dense": {"kernel": z(8, 16), "bias": z(16)},  # pre-block
+            "block": {"residual_1": {"main": {
+                "dense_1": {"kernel": z(16, 64), "bias": z(64)},   # up
+                "dense_2": {"kernel": z(64, 16), "bias": z(16)},   # down
+            }}},
+        }
+        specs = tensor.tensor_parallel_specs(params)
+        mlp = specs["block"]["residual_1"]["main"]
+        assert mlp["dense_1"]["kernel"] == P(None, "model")  # local rank 0
+        assert mlp["dense_1"]["bias"] == P("model")
+        assert mlp["dense_2"]["kernel"] == P("model", None)  # local rank 1
+        assert mlp["dense_2"]["bias"] == P()
+        # the standalone head keeps column parallelism
+        assert specs["dense"]["kernel"] == P(None, "model")
+
     def test_optimizer_state_inherits_param_specs(self):
         model = build_transformer_lm(VOCAB, SEQ, d_model=32, depth=1,
                                      num_heads=4)
@@ -279,6 +304,48 @@ class TestModelParallelFlash:
         with td.MirroredStrategy().scope() as s:
             s.run(step, (jnp.zeros((8, 4)),))
         assert seen and all(m is None for m in seen)
+
+
+class TestUnmappableFlashFallsBackToDense:
+    def test_dense_when_mapping_declines_on_multi_device_mesh(
+            self, eight_devices, monkeypatch):
+        # When no shard mapping applies on a >1-device mesh (here: batch 3
+        # and heads 5 divide neither axis), the dispatch must take DENSE
+        # attention — GSPMD partitions it natively — never the unwrapped
+        # Pallas kernel, which the partitioner would silently all-gather
+        # and recompute globally (ADVICE r3).
+        import jax.numpy as jnp
+        from tpu_dist.models import transformer as tr
+        from tpu_dist.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "use_flash", lambda q: True)
+
+        def boom(*a, **k):
+            raise AssertionError("unwrapped Pallas kernel dispatched on a "
+                                 "multi-device mesh")
+
+        monkeypatch.setattr(fa, "flash_attention", boom)
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(3, 5, 128, 64)), jnp.float32)
+        strategy = td.MirroredStrategy(axis_shapes={"data": 2, "model": 4})
+        with strategy.scope():
+            out = tr._default_attention(q, q, q, causal=True, scale=0.125)
+        want = tr._dense_attention(q, q, q, causal=True, scale=0.125)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_unwrapped_kernel_still_used_where_safe(self, monkeypatch):
+        # Single-device mesh (or no scope): the raw kernel cannot be
+        # all-gathered, so it must still dispatch (the fast path).
+        from tpu_dist.models import transformer as tr
+
+        assert tr._unwrapped_flash_safe()  # no scope
+        strategy = td.MirroredStrategy(devices=jax.devices()[:1])
+        with strategy.scope():
+            assert tr._unwrapped_flash_safe()
+        strategy2 = td.MirroredStrategy()
+        with strategy2.scope():
+            assert not tr._unwrapped_flash_safe()
 
 
 class TestTensorParallelMixedPrecision:

@@ -155,6 +155,37 @@ def _conv_im2col(x, w):
     return out.reshape(b, oh, ow, cout)
 
 
+@jax.custom_vjp
+def _nonoverlap_maxpool(xw):
+    """Max over the window axes of a [B, OH, WH, OW, WW, C] view.
+
+    Plain ``jnp.max`` SPLITS the cotangent across tied window maxima
+    (common post-ReLU), while reduce_window's gradient routes it to one
+    element — so the CPU fast path carries a custom VJP that one-hot
+    routes to the FIRST tied element in row-major window scan order
+    (select-and-scatter's ge-select winner), keeping CPU and TPU training
+    gradients identical (ADVICE r3)."""
+    return jnp.max(xw, axis=(2, 4))
+
+
+def _nonoverlap_maxpool_fwd(xw):
+    b, oh, wh, ow, ww, c = xw.shape
+    t = xw.transpose(0, 1, 3, 5, 2, 4).reshape(b, oh, ow, c, wh * ww)
+    idx = jnp.argmax(t, axis=-1)  # first max in row-major window order
+    y = jnp.take_along_axis(t, idx[..., None], axis=-1)[..., 0]
+    return y, (idx, xw.shape)
+
+
+def _nonoverlap_maxpool_bwd(res, g):
+    idx, (b, oh, wh, ow, ww, c) = res
+    onehot = jax.nn.one_hot(idx, wh * ww, dtype=g.dtype)
+    gt = (g[..., None] * onehot).reshape(b, oh, ow, c, wh, ww)
+    return (gt.transpose(0, 1, 4, 2, 5, 3),)
+
+
+_nonoverlap_maxpool.defvjp(_nonoverlap_maxpool_fwd, _nonoverlap_maxpool_bwd)
+
+
 def _pool(x, window, strides, padding, init_val, op):
     wh, ww = _pair(window)
     sh, sw = _pair(strides)
@@ -163,18 +194,19 @@ def _pool(x, window, strides, padding, init_val, op):
             and jax.default_backend() == "cpu"):
         # Non-overlapping windows (the reference's pool_size=2 default):
         # reshape + axis-reduce is exactly reduce_window VALID forward
-        # (both crop trailing rows/cols), but its GRADIENT is an equality
-        # mask — on tied window maxima it SPLITS the cotangent instead of
-        # select_and_scatter's one-hot routing. CPU-only: XLA:CPU lowers
+        # (both crop trailing rows/cols). CPU-only: XLA:CPU lowers
         # select_and_scatter to a ~200 ms/step scatter loop at the
         # reference's batch (pools were 2/3 of the whole step); TPU keeps
-        # reduce_window so its gradient semantics are unchanged.
+        # reduce_window (MXU/VPU-native). Max carries a custom VJP so tied
+        # maxima route like reduce_window's gradient — see
+        # _nonoverlap_maxpool.
         b, h, w, c = x.shape
         oh, ow = h // wh, w // ww
         x = x[:, :oh * wh, :ow * ww, :]
         x = x.reshape(b, oh, wh, ow, ww, c)
-        reducer = jnp.max if op is jax.lax.max else jnp.sum
-        return reducer(x, axis=(2, 4))
+        if op is jax.lax.max:
+            return _nonoverlap_maxpool(x)
+        return jnp.sum(x, axis=(2, 4))
     return jax.lax.reduce_window(
         x, init_val, op,
         window_dimensions=(1, wh, ww, 1),

@@ -58,11 +58,15 @@ def _decode_value(v):
     if isinstance(v, dict) and "__layer__" in v:
         return layer_from_config(v["__layer__"])
     if isinstance(v, dict) and "__attention__" in v:
-        from tpu_dist.parallel import sequence as sequence_mod
+        from tpu_dist.parallel.sequence import RingAttention
 
         spec = v["__attention__"]
-        cls = getattr(sequence_mod, spec["class"], None)
-        if cls is None or not isinstance(cls, type):
+        # Explicit allowlist, NOT getattr on the module: a crafted
+        # model.json must not be able to instantiate arbitrary importable
+        # classes with attacker-chosen kwargs (ADVICE r3).
+        allowed = {"RingAttention": RingAttention}
+        cls = allowed.get(spec["class"])
+        if cls is None:
             raise ValueError(
                 f"unknown attention spec class {spec['class']!r}")
         return cls(**spec["config"])
@@ -89,7 +93,10 @@ def layer_from_config(spec: dict):
 
     cls = getattr(layers_mod, spec["class"],
                   getattr(transformer_mod, spec["class"], None))
-    if cls is None or not isinstance(cls, type):
+    # Layer subclasses only — the modules also import unrelated classes
+    # (PartitionSpec, ...) that a crafted model.json must not reach.
+    if (cls is None or not isinstance(cls, type)
+            or not issubclass(cls, layers_mod.Layer)):
         raise ValueError(f"unknown layer class {spec['class']!r}")
     kwargs = {k: _decode_value(v) for k, v in spec["config"].items()}
     # JSON turns tuples (kernel_size, strides, pool_size...) into lists;

@@ -172,20 +172,44 @@ def _mesh_mapped_flash(q, *, causal: bool, scale: float,
                          out_specs=spec, check_rep=False)
 
 
+def _unwrapped_flash_safe() -> bool:
+    """Whether the RAW (un-shard_map'd) Pallas kernel can run without GSPMD
+    silently all-gathering its operands: true when nothing is sharded (no
+    strategy scope / 1-device mesh) or when the caller is already inside the
+    mesh's manual axes (``strategy.run`` / shard_map — operands are per-shard
+    values there). On a >1-device mesh OUTSIDE manual axes the custom call is
+    opaque to the partitioner, so the only safe fallbacks are a mapped kernel
+    or dense attention. NOTE the polarity on an unreadable axis env:
+    ``manual_axes_state() is True`` — "can't confirm" must gate the raw
+    kernel OFF here, the opposite of inside_manual_axes's decline default."""
+    from tpu_dist.parallel import mesh as mesh_lib
+    from tpu_dist.parallel.strategy import get_strategy, has_strategy
+
+    if not has_strategy():
+        return True
+    mesh = get_strategy().mesh
+    return (mesh.devices.size <= 1
+            or mesh_lib.manual_axes_state(mesh) is True)
+
+
 def _default_attention(q, k, v, *, causal: bool, scale: float):
-    """Single-device attention dispatch: the fused flash kernel
-    (ops/flash_attention.py) on TPU for supported shapes — O(L) memory,
-    tiled online softmax; on a >1-device mesh the kernel maps per
-    data/model shard via shard_map (batch entries and heads are
-    independent) — else the dense reference path, which GSPMD partitions
-    natively. TPU_DIST_FLASH=0 forces dense for A/B measurement."""
+    """Attention dispatch: the fused flash kernel (ops/flash_attention.py)
+    on TPU for supported shapes — O(L) memory, tiled online softmax; on a
+    >1-device mesh the kernel maps per data/model shard via shard_map
+    (batch entries and heads are independent). When no shard mapping
+    applies (indivisible batch/heads, per-shard shape outside the kernel
+    envelope) the UNWRAPPED kernel runs only where it cannot be silently
+    all-gathered (single device, or already inside manual axes); otherwise
+    dense attention runs — GSPMD partitions it natively (ADVICE r3).
+    TPU_DIST_FLASH=0 forces dense for A/B measurement."""
     from tpu_dist.ops import flash_attention as fa
 
     if fa.use_flash(q):
         mapped = _mesh_mapped_flash(q, causal=causal, scale=scale)
         if mapped is not None:
             return mapped(q, k, v)
-        return fa.flash_attention(q, k, v, causal=causal, scale=scale)
+        if _unwrapped_flash_safe():
+            return fa.flash_attention(q, k, v, causal=causal, scale=scale)
     return _dense_attention(q, k, v, causal=causal, scale=scale)
 
 

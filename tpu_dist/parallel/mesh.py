@@ -41,19 +41,27 @@ def get_shard_map():
     return shard_map
 
 
-def inside_manual_axes(mesh) -> bool:
-    """True when any of ``mesh``'s axis names is already bound in the
-    current trace (i.e. we are inside a shard_map over it — e.g. a model
-    applied within ``strategy.run``): binding the same axis twice raises,
-    so callers use this to decline nested mappings. Conservative: if the
-    axis environment can't be read, report True (decline)."""
+def manual_axes_state(mesh) -> bool | None:
+    """Whether any of ``mesh``'s axis names is already bound in the current
+    trace (inside a shard_map over it, e.g. a model applied within
+    ``strategy.run``) — or ``None`` when the axis environment can't be read
+    (jax internals moved). Callers pick their own conservative direction
+    for ``None``: decliners of nested mappings treat it as "inside", while
+    safety gates for raw kernels must treat it as "can't confirm"."""
     try:
         from jax._src.core import get_axis_env
 
         bound = set(get_axis_env().axis_sizes)
     except Exception:  # pragma: no cover - jax internals moved
-        return True
+        return None
     return bool(bound & set(mesh.axis_names))
+
+
+def inside_manual_axes(mesh) -> bool:
+    """True when a mesh axis is already bound (binding it twice raises, so
+    callers decline nested mappings). Conservative: unreadable → True."""
+    state = manual_axes_state(mesh)
+    return True if state is None else state
 
 
 def make_mesh(axis_shapes: Mapping[str, int] | None = None,

@@ -56,20 +56,44 @@ def _base(name: str) -> str:
     return head if head and tail.isdigit() else name
 
 
-def _dense_is_column(layer_name: str) -> bool:
-    """Within one layer chain, alternate Dense layers column/row: the
-    uniquing suffix (dense, dense_1, dense_2, ...) gives the position.
-    Even positions (up-projections, heads) are column-parallel; odd
-    (down-projections back to the residual stream) row-parallel. This
-    matches TransformerBlock's MLP (dense=up, dense_1=down) and makes a
-    standalone head (plain 'dense') column-parallel."""
+def _name_index(layer_name: str) -> int:
+    """Uniquing suffix as an integer: dense -> 0, dense_7 -> 7."""
     _, _, tail = layer_name.rpartition("_")
-    idx = int(tail) if tail.isdigit() else 0
-    return idx % 2 == 0
+    return int(tail) if tail.isdigit() else 0
 
 
-def spec_for_param(path, leaf, *, axis_name: str = MODEL_AXIS) -> P:
-    """Megatron-style PartitionSpec for one parameter, by its tree path."""
+def _dense_ranks(params) -> dict[tuple, int]:
+    """STRUCTURAL position of each Dense layer among its Dense siblings
+    under the same parent container, ordered by uniquing index — keyed by
+    the layer's full path-name tuple.
+
+    The name-uniquing counter is model-GLOBAL, so its parity says nothing
+    about a layer's role once any extra Dense shifts it (ADVICE r3: an
+    extra head before a block flipped every later layer's column/row
+    assignment). Position within the owning chain is what the Megatron
+    up/down alternation is actually about."""
+    siblings: dict[tuple, set[str]] = {}
+    for path, _ in jax.tree_util.tree_flatten_with_path(params)[0]:
+        names = _dict_path_names(path)
+        if len(names) >= 2 and _base(names[-2]) == "dense":
+            siblings.setdefault(tuple(names[:-2]), set()).add(names[-2])
+    ranks: dict[tuple, int] = {}
+    for parent, layer_names in siblings.items():
+        for rank, name in enumerate(sorted(layer_names, key=_name_index)):
+            ranks[parent + (name,)] = rank
+    return ranks
+
+
+def spec_for_param(path, leaf, *, axis_name: str = MODEL_AXIS,
+                   dense_rank: int | None = None) -> P:
+    """Megatron-style PartitionSpec for one parameter, by its tree path.
+
+    ``dense_rank`` is the Dense layer's structural position among its
+    Dense siblings (see :func:`_dense_ranks`); even ranks (up-projections,
+    heads) shard column-parallel, odd ranks (down-projections back to the
+    residual stream) row-parallel — matching TransformerBlock's MLP and
+    making a standalone head column-parallel. When absent (direct
+    single-path calls), the uniquing suffix stands in."""
     names = _dict_path_names(path)
     if len(names) < 2:
         return P()
@@ -83,7 +107,9 @@ def spec_for_param(path, leaf, *, axis_name: str = MODEL_AXIS) -> P:
             return P(axis_name, None)
         return P()  # bo: row-parallel output bias is replicated
     if layer == "dense" and getattr(leaf, "ndim", 0) in (1, 2):
-        if _dense_is_column(names[-2]):
+        if dense_rank is None:
+            dense_rank = _name_index(names[-2])
+        if dense_rank % 2 == 0:
             return (P(None, axis_name) if leaf.ndim == 2
                     else P(axis_name))
         return P(axis_name, None) if leaf.ndim == 2 else P()
@@ -92,9 +118,14 @@ def spec_for_param(path, leaf, *, axis_name: str = MODEL_AXIS) -> P:
 
 def tensor_parallel_specs(params, *, axis_name: str = MODEL_AXIS):
     """PartitionSpec tree for a params tree (shape mirrors ``params``)."""
-    return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: spec_for_param(path, leaf, axis_name=axis_name),
-        params)
+    ranks = _dense_ranks(params)
+
+    def one(path, leaf):
+        names = _dict_path_names(path)
+        return spec_for_param(path, leaf, axis_name=axis_name,
+                              dense_rank=ranks.get(tuple(names[:-1])))
+
+    return jax.tree_util.tree_map_with_path(one, params)
 
 
 def specs_like_params(tree, params_specs) -> Any:

@@ -40,12 +40,21 @@ def _to_host(leaf) -> np.ndarray:
     COLLECTIVE: every process must reach it (callers hoist flattening out of
     chief-only branches; the addressability predicate is uniform across
     processes because it is a property of the one global array)."""
-    if isinstance(leaf, jax.Array) and not (
-            leaf.is_fully_addressable or leaf.is_fully_replicated):
+    if _needs_allgather(leaf):
         from jax.experimental import multihost_utils
 
         return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
     return np.asarray(leaf)
+
+
+def _needs_allgather(leaf) -> bool:
+    """The ONE definition of "this leaf's host copy requires a collective".
+
+    Chief and peers count collectives off this predicate; two drifting
+    copies would mean mismatched process_allgather calls — a cluster-wide
+    hang, not a wrong answer. Keep every caller on this helper."""
+    return isinstance(leaf, jax.Array) and not (
+        leaf.is_fully_addressable or leaf.is_fully_replicated)
 
 
 def _placeholder(leaf) -> np.ndarray:
@@ -58,10 +67,7 @@ def _placeholder(leaf) -> np.ndarray:
 
 
 def _needs_gather(tree) -> bool:
-    return any(
-        isinstance(l, jax.Array) and not (
-            l.is_fully_addressable or l.is_fully_replicated)
-        for l in jax.tree_util.tree_leaves(tree))
+    return any(_needs_allgather(l) for l in jax.tree_util.tree_leaves(tree))
 
 
 def _flatten(tree) -> dict[str, np.ndarray]:
@@ -116,8 +122,7 @@ def save(directory: str | os.PathLike, model_or_variables, *, step: int,
     # old shape (chief-only host copy, peers untouched).
     if _needs_gather(saveable) and not bootstrap.is_chief():
         for leaf in jax.tree_util.tree_leaves(saveable):
-            if isinstance(leaf, jax.Array) and not (
-                    leaf.is_fully_addressable or leaf.is_fully_replicated):
+            if _needs_allgather(leaf):
                 _to_host(leaf)
     if bootstrap.is_chief():
         directory.mkdir(parents=True, exist_ok=True)
