@@ -340,6 +340,25 @@ def run_e2e_fit(config: str, epochs: int, steps_per_epoch: int,
     if pipeline == "device":
         ds = device_pipeline(dataset_name, global_batch_size=global_batch,
                              synthetic_size=max(8192, need))
+    elif pipeline == "refchain":
+        # The LITERAL reference pipeline shape (tf_dist_example.py:20-33)
+        # through the public combinators — exercises the vectorize pass's
+        # device-residency promotion (data/vectorize.py), i.e. what a user
+        # porting the reference script actually gets from fit().
+        import jax.numpy as jnp
+
+        from tpu_dist.data.pipeline import Dataset
+        from tpu_dist.data.sources import load_arrays
+
+        images, labels = load_arrays(dataset_name, "train",
+                                     synthetic_size=max(8192, need))
+
+        def scale(image, label):
+            return jnp.asarray(image, jnp.float32) / 255.0, label
+
+        ds = (Dataset.from_tensor_slices((images, labels)).map(scale)
+              .cache().shuffle(10000).batch(global_batch,
+                                            drop_remainder=True))
     else:
         ds = native_pipeline(dataset_name, global_batch_size=global_batch,
                              synthetic_size=max(8192, need))
@@ -351,7 +370,7 @@ def run_e2e_fit(config: str, epochs: int, steps_per_epoch: int,
 
     total_steps = epochs * steps_per_epoch
     img_per_sec = global_batch * total_steps / elapsed
-    return {
+    result = {
         "config": config,
         "mode": f"e2e_fit_{pipeline}",
         "input_pipeline": pipeline,
@@ -365,6 +384,20 @@ def run_e2e_fit(config: str, epochs: int, steps_per_epoch: int,
         "images_per_sec": round(img_per_sec, 1),
         "images_per_sec_per_core": round(img_per_sec / n_dev, 1),
     }
+    if pipeline == "host":
+        transform = getattr(ds, "_device_transform", None)
+        result["transfer"] = "uint8" if transform is not None else "float32"
+        result["h2d_floor_note"] = (
+            "true streaming path: every image crosses the host->device "
+            "link each step. Measured link bandwidth through this host's "
+            "TPU tunnel is ~18 MB/s (forced-reduction probe, r4), so "
+            "uint8 MNIST caps at ~23k img/s/core regardless of host-side "
+            "speed; the r4 uint8-over-the-wire + scale-on-device split "
+            "runs at that ceiling (was 8.0k at f32 in r3). Real TPU "
+            "hosts feed over PCIe (GB/s) where this path is compute-bound; "
+            "HBM-resident sources take the promoted device path instead "
+            "(see e2e_fit_refchain).")
+    return result
 
 
 # -- subprocess modes ---------------------------------------------------------
@@ -643,6 +676,12 @@ def driver_run() -> int:
         "mnist_cnn_e2e_fit_hostpipe": lambda: run_e2e_fit(
             "mnist_cnn", epochs=1, steps_per_epoch=100, global_batch=128,
             pipeline="host"),
+        # The ported reference script's own pipeline shape through the
+        # public combinators (load -> map(scale) -> cache -> shuffle ->
+        # batch): the vectorize pass promotes it to device residency.
+        "mnist_cnn_e2e_fit_refchain": lambda: run_e2e_fit(
+            "mnist_cnn", epochs=3, steps_per_epoch=100, global_batch=128,
+            pipeline="refchain"),
         "resnet18": lambda: run_step_bench(
             "resnet18", steps=96, warmup=16, global_batch=256, spe=8),
         "resnet50": lambda: run_step_bench(
@@ -723,11 +762,18 @@ def driver_run() -> int:
         "unit": "images/sec/core",
         "steps_per_execution": headline["steps_per_execution"],
         "mfu_pct": headline.get("mfu_pct"),
+        "headline_note": ("mnist step is dispatch-bound (~0.5 ms compute); "
+                          "its mfu_pct measures dispatch amortization, not "
+                          "the MXU — see highlights for MXU-bound configs"),
         "vs_baseline": vs_baseline,
         "vs_baseline_basis": basis,
         "highlights": {
             "e2e_fit_img_s_core": _pick("mnist_cnn_e2e_fit",
                                         "images_per_sec_per_core"),
+            "e2e_refchain_img_s_core": _pick("mnist_cnn_e2e_fit_refchain",
+                                             "images_per_sec_per_core"),
+            "hostpipe_img_s_core": _pick("mnist_cnn_e2e_fit_hostpipe",
+                                         "images_per_sec_per_core"),
             "resnet50_bf16_mfu_pct": _pick("resnet50_bf16", "mfu_pct"),
             "resnet50_fp32_mfu_pct": _pick("resnet50", "mfu_pct"),
             "lm_bf16_mfu_pct": _pick("transformer_lm_bf16", "mfu_pct"),
@@ -755,10 +801,11 @@ def main(argv=None) -> int:
     parser.add_argument("--e2e", action="store_true",
                         help="measure end-to-end fit() instead of the "
                              "compiled step")
-    parser.add_argument("--pipeline", choices=("device", "host"),
+    parser.add_argument("--pipeline", choices=("device", "host", "refchain"),
                         default="device",
-                        help="e2e input path: device-resident gather or "
-                             "host streaming loader")
+                        help="e2e input path: device-resident gather, host "
+                             "streaming loader, or the literal reference "
+                             "combinator chain (vectorize promotion)")
     parser.add_argument("--scaling", action="store_true",
                         help="1/2/4/8-device virtual-CPU fixed-global-work "
                              "partition-overhead table")

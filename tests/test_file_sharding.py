@@ -193,7 +193,15 @@ class TestDistributedPrefetchDefault:
 
         already = plain.prefetch(3)
         dist2 = DistributedDataset(already, strategy)
-        assert dist2._local is already  # no second wrap
+        # The vectorize rewrite may replace the object, but the chain must
+        # carry exactly ONE prefetch, with the user's buffer size (never a
+        # second default wrap on top).
+        node, prefetches = dist2._local, []
+        while node is not None:
+            if node._transform and node._transform[0] == "prefetch":
+                prefetches.append(node._transform[1]["buffer_size"])
+            node = node._parent
+        assert prefetches == [3], prefetches
 
         # The marker survives further derivation (e.g. a post-prefetch map).
         derived = already.map(lambda a, b: (a, b))

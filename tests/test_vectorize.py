@@ -1,0 +1,407 @@
+"""Vectorized chain-rewrite tests (tpu_dist.data.vectorize).
+
+Bar: the rewrite is a pure execution-strategy change — every batch stream
+it produces must equal the element path's (bit-identical when seeded),
+and any chain outside the grammar must decline so correctness never
+depends on the rewrite firing. This is the Grappler map_and_batch /
+vectorization analog (SURVEY.md D13: TF rewrites dataset graphs in C++;
+tpu-dist rewrites its recorded combinator chains).
+"""
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.data import vectorize
+from tpu_dist.data.pipeline import Dataset
+
+
+def _mnist_arrays(n=512):
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(n, 28, 28, 1), dtype=np.uint8)
+    y = rng.integers(0, 10, size=(n,)).astype(np.int64)
+    return x, y
+
+
+def _scale(image, label):
+    return np.asarray(image, np.float32) / 255.0, label
+
+
+def _batches(ds, limit=None):
+    out = []
+    for i, b in enumerate(ds):
+        if limit is not None and i >= limit:
+            break
+        out.append(b)
+    return out
+
+
+def _assert_stream_equal(got, want):
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        assert isinstance(g, tuple) and len(g) == len(w)
+        for ga, wa in zip(g, w):
+            ga, wa = np.asarray(ga), np.asarray(wa)
+            assert ga.dtype == wa.dtype, (ga.dtype, wa.dtype)
+            np.testing.assert_array_equal(ga, wa)
+
+
+class TestRewriteEquivalence:
+    def test_reference_chain_seeded_bit_identical(self):
+        # load -> map(scale) -> cache -> shuffle(seeded) -> batch: the
+        # reference pipeline shape. Seeded shuffle => the index-space
+        # replay must reproduce the element path's batches EXACTLY.
+        x, y = _mnist_arrays()
+
+        def build():
+            return (Dataset.from_tensor_slices((x, y)).map(_scale).cache()
+                    .shuffle(100, seed=7).batch(64))
+
+        fast = vectorize.try_rewrite(build(), defer_scale_to_device=False)
+        assert fast is not None
+        _assert_stream_equal(_batches(fast), _batches(build()))
+
+    def test_full_buffer_shuffle_bit_identical(self):
+        x, y = _mnist_arrays(256)
+
+        def build():
+            return (Dataset.from_tensor_slices((x, y)).map(_scale)
+                    .shuffle(10000, seed=3).batch(32))
+
+        fast = vectorize.try_rewrite(build(), defer_scale_to_device=False)
+        assert fast is not None
+        _assert_stream_equal(_batches(fast), _batches(build()))
+
+    def test_second_epoch_reshuffles_like_element_path(self):
+        x, y = _mnist_arrays(256)
+
+        def build():
+            return (Dataset.from_tensor_slices((x, y))
+                    .shuffle(64, seed=11).batch(32))
+
+        fast = vectorize.try_rewrite(build())
+        ref = build()
+        # two passes each; both must match pass-for-pass (epoch advances
+        # the seeded rng identically) and differ across passes (reshuffle)
+        f1, f2 = _batches(fast), _batches(fast)
+        r1, r2 = _batches(ref), _batches(ref)
+        _assert_stream_equal(f1, r1)
+        _assert_stream_equal(f2, r2)
+        assert not all(
+            np.array_equal(a[0], b[0]) for a, b in zip(f1, f2))
+
+    def test_unseeded_shuffle_same_multiset(self):
+        x, y = _mnist_arrays(128)
+        ds = (Dataset.from_tensor_slices((x, y)).map(_scale)
+              .shuffle(10000).batch(32))
+        fast = vectorize.try_rewrite(ds, defer_scale_to_device=False)
+        assert fast is not None
+        got = np.concatenate([b[1] for b in _batches(fast)])
+        assert sorted(got.tolist()) == sorted(y.tolist())
+
+    def test_post_batch_ops_fold_in_order(self):
+        x, y = _mnist_arrays(128)
+
+        def chains():
+            base = Dataset.from_tensor_slices((x, y)).batch(16)
+            return (base.take(3).repeat(2), base.repeat(2).take(3),
+                    base.skip(2).repeat(1))
+
+        for ds in chains():
+            fast = vectorize.try_rewrite(ds)
+            assert fast is not None
+            _assert_stream_equal(_batches(fast), _batches(ds))
+
+    def test_skip_take_shard_before_batch(self):
+        x, y = _mnist_arrays(128)
+
+        def build():
+            return (Dataset.from_tensor_slices((x, y)).skip(8).take(100)
+                    .shard(2, 1).batch(8))
+
+        fast = vectorize.try_rewrite(build())
+        assert fast is not None
+        _assert_stream_equal(_batches(fast), _batches(build()))
+
+    def test_drop_remainder_and_short_final_batch(self):
+        x, y = _mnist_arrays(100)
+        for drop in (True, False):
+            ds = Dataset.from_tensor_slices((x, y)).batch(
+                32, drop_remainder=drop)
+            fast = vectorize.try_rewrite(ds)
+            assert fast is not None
+            _assert_stream_equal(_batches(fast), _batches(ds))
+
+    def test_generic_vectorizable_map_without_cache(self):
+        # A float map that is elementwise (probe passes) but not the
+        # scale shape — the generic batched-apply path.
+        x = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+        y = np.arange(64, dtype=np.int64)
+
+        def affine(a, b):
+            return a * 2.0 - 1.0, b
+
+        ds = Dataset.from_tensor_slices((x, y)).map(affine).batch(16)
+        fast = vectorize.try_rewrite(ds)
+        assert fast is not None
+        _assert_stream_equal(_batches(fast), _batches(ds))
+
+
+class TestRewriteDeclines:
+    def test_random_map_declines(self):
+        x, y = _mnist_arrays(64)
+        rng = np.random.default_rng(5)
+
+        def augment(a, b):
+            return a.astype(np.float32) + rng.normal(), b
+
+        ds = Dataset.from_tensor_slices((x, y)).map(augment).batch(16)
+        assert vectorize.try_rewrite(ds) is None
+
+    def test_non_batch_safe_map_declines(self):
+        x = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+        y = np.arange(64, dtype=np.int64)
+
+        def flatten(a, b):
+            return a.reshape(-1), b  # batched reshape != stacked reshapes
+
+        ds = Dataset.from_tensor_slices((x, y)).map(flatten).batch(16)
+        assert vectorize.try_rewrite(ds) is None
+
+    def test_filter_and_generator_sources_decline(self):
+        x, y = _mnist_arrays(64)
+        ds = (Dataset.from_tensor_slices((x, y))
+              .filter(lambda a, b: b < 5).batch(8))
+        assert vectorize.try_rewrite(ds) is None
+        gen = Dataset.from_generator(lambda: iter([1, 2, 3])).batch(2)
+        assert vectorize.try_rewrite(gen) is None
+
+    def test_cache_after_shuffle_declines(self):
+        x, y = _mnist_arrays(64)
+        ds = (Dataset.from_tensor_slices((x, y)).shuffle(16, seed=1)
+              .cache().batch(8))
+        assert vectorize.try_rewrite(ds) is None
+
+    def test_env_kill_switch(self, monkeypatch):
+        x, y = _mnist_arrays(64)
+        ds = Dataset.from_tensor_slices((x, y)).batch(8)
+        monkeypatch.setenv("TPU_DIST_VECTORIZE", "0")
+        assert vectorize.try_rewrite(ds) is None
+
+
+class TestScaleFusion:
+    def test_scale_detected_and_fused_on_host(self):
+        x, y = _mnist_arrays(128)
+        ds = (Dataset.from_tensor_slices((x, y)).map(_scale).cache()
+              .shuffle(10000, seed=2).batch(32))
+        fast = vectorize.try_rewrite(ds, defer_scale_to_device=False)
+        assert fast is not None
+        assert fast._device_transform is None
+        _assert_stream_equal(_batches(fast), _batches(ds))
+
+    def test_scale_deferred_to_device(self):
+        x, y = _mnist_arrays(128)
+        ds = (Dataset.from_tensor_slices((x, y)).map(_scale)
+              .shuffle(10000, seed=2).batch(32))
+        fast = vectorize.try_rewrite(ds, defer_scale_to_device=True)
+        assert fast is not None
+        t = fast._device_transform
+        # the reference's fn divides by 255.0; the exact formula is kept
+        assert t is not None and t._op == "div" and t._scale == 255.0
+        # wire batches are raw uint8; transform(batch) == element path
+        fb, rb = _batches(fast), _batches(ds)
+        assert len(fb) == len(rb)
+        for (gx, gy), (wx, wy) in zip(fb, rb):
+            assert np.asarray(gx).dtype == np.uint8
+            np.testing.assert_allclose(np.asarray(t(gx)), np.asarray(wx),
+                                       rtol=0, atol=0)
+            np.testing.assert_array_equal(gy, wy)
+
+    def test_non_unit_scale_detected(self):
+        x, y = _mnist_arrays(64)
+
+        def scale2(image, label):
+            return np.asarray(image, np.float32) * np.float32(2.0), label
+
+        ds = Dataset.from_tensor_slices((x, y)).map(scale2).batch(16)
+        fast = vectorize.try_rewrite(ds, defer_scale_to_device=True)
+        assert fast is not None
+        t = fast._device_transform
+        assert t._op == "mul" and abs(t._scale - 2.0) < 1e-12
+
+
+class TestTrainerIntegration:
+    def test_fit_equal_with_and_without_rewrite(self, eight_devices,
+                                                monkeypatch):
+        # The reference-shaped pipeline through model.fit: the rewrite must
+        # not change a single reported loss.
+        x, y = _mnist_arrays(512)
+
+        def run():
+            strategy = td.MirroredStrategy()
+            ds = (Dataset.from_tensor_slices((x, y)).map(_scale).cache()
+                  .shuffle(10000, seed=5).batch(128).repeat())
+            with strategy.scope():
+                model = td.models.build_and_compile_cnn_model()
+            h = model.fit(ds, epochs=2, steps_per_epoch=3, verbose=0)
+            return h.history["loss"]
+
+        fast_losses = run()
+        monkeypatch.setenv("TPU_DIST_VECTORIZE", "0")
+        ref_losses = run()
+        np.testing.assert_allclose(fast_losses, ref_losses,
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_u8_transfer_fit_eval_predict_match_f32(self, eight_devices):
+        # native_pipeline(transfer=uint8) defers the scale to the compiled
+        # step; losses/metrics/predictions must equal the f32-transfer
+        # pipeline exactly (same seed => same shuffled stream).
+        from tpu_dist.data.native import native_pipeline
+
+        def run(transfer):
+            strategy = td.MirroredStrategy()
+            ds = native_pipeline("mnist", global_batch_size=128, seed=0,
+                                 synthetic_size=1024, transfer=transfer)
+            with strategy.scope():
+                model = td.models.build_and_compile_cnn_model()
+            h = model.fit(ds, epochs=2, steps_per_epoch=3, verbose=0)
+            logs = model.evaluate(ds, steps=2, verbose=0)
+            return h.history["loss"], logs
+
+        l_u8, e_u8 = run("uint8")
+        l_f32, e_f32 = run("float32")
+        np.testing.assert_allclose(l_u8, l_f32, rtol=1e-6, atol=1e-6)
+        assert abs(e_u8["loss"] - e_f32["loss"]) < 1e-6
+
+    def test_distributed_dataset_applies_rewrite(self, eight_devices):
+        from tpu_dist.data.distribute import DistributedDataset
+
+        x, y = _mnist_arrays(512)
+        strategy = td.MirroredStrategy()
+        ds = (Dataset.from_tensor_slices((x, y)).map(_scale).cache()
+              .shuffle(10000, seed=5).batch(128))
+        with strategy.scope():
+            dist = DistributedDataset(ds, strategy)
+        assert getattr(dist._local, "_prefetched", False)
+        # the chain under the prefetch wrapper is the vectorized one
+        node = dist._local
+        while node is not None and not getattr(node, "_vectorized", False):
+            node = node._parent
+        assert node is not None and node._vectorized
+
+
+class TestDevicePromotion:
+    """try_promote_to_device: HBM-resident delivery for reference-shaped
+    chains. On the CPU test backend promotion declines by design, so these
+    tests force the backend check where promotion itself is under test."""
+
+    def _chain(self, n=256, batch=32, shuffle=True, seed=None):
+        x, y = _mnist_arrays(n)
+        ds = Dataset.from_tensor_slices((x, y)).map(_scale).cache()
+        if shuffle:
+            ds = ds.shuffle(10000, seed=seed)
+        return ds.batch(batch), (x, y)
+
+    def test_declines_on_cpu_backend(self):
+        ds, _ = self._chain()
+        assert vectorize.try_promote_to_device(ds) is None
+
+    def test_promotes_and_matches_data(self, eight_devices, monkeypatch):
+        import jax
+
+        from tpu_dist.data.device import DeviceDataset
+
+        monkeypatch.setattr(vectorize, "enabled", lambda: True)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        ds, (x, y) = self._chain()
+        strategy = td.MirroredStrategy()
+        with strategy.scope():
+            out = vectorize.try_promote_to_device(ds)
+            assert isinstance(out, DeviceDataset)
+            out.bind_strategy(strategy)
+            # a full epoch of device batches covers the same multiset,
+            # scaled exactly like the host map
+            got_x, got_y = [], []
+            for _ in range(out.cardinality()):
+                xb, yb = out.next_batch()
+                got_x.append(np.asarray(xb))
+                got_y.append(np.asarray(yb))
+        got_y = np.concatenate(got_y)
+        assert sorted(got_y.tolist()) == sorted(y.tolist())
+        gx = np.concatenate(got_x)
+        assert gx.dtype == np.float32
+        assert gx.max() <= 1.0 and gx.min() >= 0.0
+        # memoized: second call returns the same object (one upload)
+        assert vectorize.try_promote_to_device(ds) is out
+
+    def test_declines_seeded_shuffle_and_repeat_and_remainder(
+            self, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        seeded, _ = self._chain(seed=9)
+        assert vectorize.try_promote_to_device(seeded) is None
+        repeated, _ = self._chain()
+        assert vectorize.try_promote_to_device(repeated.repeat()) is None
+        x, y = _mnist_arrays(100)
+        ragged = Dataset.from_tensor_slices((x, y)).batch(32)
+        assert vectorize.try_promote_to_device(ragged) is None
+        dropped = Dataset.from_tensor_slices((x, y)).batch(
+            32, drop_remainder=True)
+        assert vectorize.try_promote_to_device(dropped) is not None
+
+    def test_fit_through_promotion_trains(self, eight_devices, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        x, y = _mnist_arrays(512)
+        ds = (Dataset.from_tensor_slices((x, y)).map(_scale).cache()
+              .shuffle(10000).batch(128))
+        strategy = td.MirroredStrategy()
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model()
+        h = model.fit(ds, epochs=2, steps_per_epoch=3, verbose=0)
+        assert np.isfinite(h.history["loss"][-1])
+        from tpu_dist.data.device import DeviceDataset
+
+        # fit promoted (and memoized) the chain to device residency
+        assert isinstance(ds._device_promoted, DeviceDataset)
+
+
+class TestTransformCacheStability:
+    def test_repeated_fit_keeps_compiled_step(self, eight_devices):
+        # Each fit() builds a fresh DistributedDataset and hence a fresh
+        # scale-transform closure; semantic keying must keep the cached
+        # compiled step across calls (identity keying re-jitted every fit).
+        from tpu_dist.data.native import native_pipeline
+
+        strategy = td.MirroredStrategy()
+        ds = native_pipeline("mnist", global_batch_size=128, seed=0,
+                             synthetic_size=1024, transfer="uint8")
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model()
+        model.fit(ds, epochs=1, steps_per_epoch=2, verbose=0)
+        step1 = model._trainer._train_step
+        model.fit(ds, epochs=1, steps_per_epoch=2, verbose=0)
+        assert model._trainer._train_step is step1
+        model.evaluate(ds, steps=1, verbose=0)
+        estep = model._trainer._eval_step
+        model.evaluate(ds, steps=1, verbose=0)
+        assert model._trainer._eval_step is estep
+
+    def test_make_train_function_strips_dataset_transform(self,
+                                                          eight_devices):
+        # Public custom-loop surface: a prior u8-pipeline fit must not
+        # leave its scale baked into make_train_function's step (callers
+        # feed already-normalized batches) — same rule as class_weight.
+        from tpu_dist.data.native import native_pipeline
+
+        strategy = td.MirroredStrategy()
+        ds = native_pipeline("mnist", global_batch_size=128, seed=0,
+                             synthetic_size=1024, transfer="uint8")
+        with strategy.scope():
+            model = td.models.build_and_compile_cnn_model()
+        model.fit(ds, epochs=1, steps_per_epoch=2, verbose=0)
+        assert model._trainer._device_transform is not None
+        model._trainer.make_train_function(steps_per_execution=1)
+        assert model._trainer._device_transform is None

@@ -45,7 +45,11 @@ class DeviceDataset:
 
     def __init__(self, images: np.ndarray, labels: np.ndarray, *,
                  global_batch_size: int, strategy=None, seed: int = 0,
-                 shuffle: bool = True, scale: Optional[float] = 1.0 / 255.0):
+                 shuffle: bool = True, scale: Optional[float] = 1.0 / 255.0,
+                 scale_op: str = "mul"):
+        if scale_op not in ("mul", "div"):
+            raise ValueError(f"scale_op must be 'mul' or 'div', "
+                             f"got {scale_op!r}")
         n = len(images)
         if len(labels) != n:
             raise ValueError(f"images/labels disagree: {n} vs {len(labels)}")
@@ -59,6 +63,10 @@ class DeviceDataset:
         self._seed = seed
         self._shuffle = shuffle
         self._scale = None if scale is None else float(scale)
+        #: mul vs div is bit-level: x / 255.0 != x * (1/255) in the last
+        #: ulp, and promoted chains (vectorize.py) replay the user's exact
+        #: formula.
+        self._scale_op = scale_op
         self._strategy = strategy  # None => bind to fit()'s strategy lazily
         self._dx = self._dy = None
         self._epoch = 0
@@ -127,6 +135,7 @@ class DeviceDataset:
         from jax.sharding import NamedSharding, PartitionSpec
 
         scale = self._scale
+        scale_op = self._scale_op
         spec = (PartitionSpec(None, self._axis) if stacked
                 else PartitionSpec(self._axis))
         out_sh = NamedSharding(self._mesh, spec)
@@ -134,7 +143,9 @@ class DeviceDataset:
         def gather(dx, dy, idx):
             xb = jnp.take(dx, idx, axis=0)
             if scale is not None:
-                xb = xb.astype(jnp.float32) * scale
+                xf = xb.astype(jnp.float32)
+                xb = (xf * jnp.float32(scale) if scale_op == "mul"
+                      else xf / jnp.float32(scale))
             return xb, jnp.take(dy, idx, axis=0)
 
         return jax.jit(gather, out_shardings=(out_sh, out_sh))

@@ -63,6 +63,16 @@ class DistributedDataset:
             self._local = shard_dataset(
                 dataset, self._num_processes, self._process_index,
                 self._policy, pre_batched=True)
+        # Vectorized chain rewrite (the Grappler map_and_batch/vectorize
+        # analog, data/vectorize.py): index math + batched gathers replace
+        # the per-element generator walk when the chain's shape allows —
+        # including the u8-over-the-wire + scale-on-device fusion. Applied
+        # AFTER sharding so the rewritten chain includes the shard op.
+        from tpu_dist.data import vectorize
+
+        fast = vectorize.try_rewrite(self._local)
+        if fast is not None:
+            self._local = fast
         # Host input off the step critical path by default (SURVEY.md §3.4 /
         # hard-part #5): background-prefetch the local stream unless the user
         # already did, mirroring TF's distribute-path auto-prefetch.
@@ -77,6 +87,13 @@ class DistributedDataset:
     @property
     def auto_shard_policy(self) -> AutoShardPolicy:
         return self._policy
+
+    @property
+    def device_transform(self):
+        """Jittable fn the trainer applies to the placed x batch inside the
+        compiled step (None for plain pipelines) — the device half of the
+        u8-over-the-wire normalization split."""
+        return getattr(self._local, "_device_transform", None)
 
     def iter_local(self) -> Iterator:
         """Validated HOST batches (numpy) — the pre-placement stream. Used by

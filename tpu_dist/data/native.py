@@ -180,7 +180,8 @@ def gather_labels(labels: np.ndarray, idx: np.ndarray) -> np.ndarray:
 def native_pipeline(name: str, *, global_batch_size: int, seed: int = 0,
                     split: str = "train", scale: float = 1.0 / 255.0,
                     drop_remainder: bool = True,
-                    synthetic_size: int | None = None):
+                    synthetic_size: int | None = None,
+                    transfer: str = "auto"):
     """A ``Dataset`` over a named source whose batches are assembled by the
     native core: per-epoch seeded reshuffle, fused gather+normalize.
 
@@ -188,6 +189,13 @@ def native_pipeline(name: str, *, global_batch_size: int, seed: int = 0,
     (the reference pipeline, tf_dist_example.py:20-33) with a full-dataset
     shuffle buffer; plugs into ``fit``/``experimental_distribute_dataset``
     like any other Dataset, including the shard-policy machinery.
+
+    ``transfer``: ``"float32"`` normalizes on the host (the fused C++
+    gather+scale); ``"uint8"`` ships the raw bytes and attaches the scale
+    as a device transform the trainer fuses into the compiled step — 4x
+    fewer bytes over the host->device link, which is the streaming path's
+    bottleneck (measured ~18 MB/s through this host's TPU tunnel).
+    ``"auto"`` picks uint8 on non-CPU backends when the source is uint8.
     """
     from tpu_dist.data.pipeline import Dataset
     from tpu_dist.data.sources import load_arrays
@@ -196,9 +204,22 @@ def native_pipeline(name: str, *, global_batch_size: int, seed: int = 0,
     n = len(images)
     if global_batch_size > n:
         raise ValueError(f"batch {global_batch_size} exceeds dataset size {n}")
+    if transfer == "auto":
+        import jax
+
+        transfer = ("uint8" if jax.default_backend() != "cpu"
+                    and images.dtype == np.uint8 else "float32")
+    if transfer == "uint8" and images.dtype != np.uint8:
+        raise ValueError(
+            f"transfer='uint8' requires a uint8 source, got {images.dtype}")
+    if transfer not in ("uint8", "float32"):
+        raise ValueError(f"unknown transfer mode {transfer!r}")
     epoch_counter = [0]
     steps = (n // global_batch_size if drop_remainder
              else -(-n // global_batch_size))
+    device_scale = transfer == "uint8"
+    if device_scale:
+        images = np.ascontiguousarray(images)
 
     def factory():
         # Fresh permutation each pass — Dataset re-invokes the factory per
@@ -207,6 +228,15 @@ def native_pipeline(name: str, *, global_batch_size: int, seed: int = 0,
         epoch_counter[0] += 1
         for s in range(steps):
             idx = perm[s * global_batch_size:(s + 1) * global_batch_size]
-            yield (gather_scale(images, idx, scale), gather_labels(labels, idx))
+            if device_scale:
+                yield images[idx], gather_labels(labels, idx)
+            else:
+                yield (gather_scale(images, idx, scale),
+                       gather_labels(labels, idx))
 
-    return Dataset(factory, cardinality=steps)
+    ds = Dataset(factory, cardinality=steps)
+    if device_scale:
+        from tpu_dist.data.vectorize import _device_scale_fn
+
+        ds._device_transform = _device_scale_fn(scale)
+    return ds

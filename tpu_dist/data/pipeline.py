@@ -125,6 +125,16 @@ class Dataset:
         #: Set on file-backed sources (from_files): (num_shards, index) -> a
         #: new source Dataset over the strided file subset.
         self._file_shard_fn: Callable[[int, int], "Dataset"] | None = None
+        #: In-memory source arrays (from_tensor_slices) — lets the
+        #: vectorized chain rewrite (data/vectorize.py) execute the whole
+        #: combinator chain as index math + batched gathers.
+        self._tensor_source = None
+        #: Optional jittable fn applied to the PLACED x batch inside the
+        #: compiled step (trainer plumbing): lets a pipeline ship compact
+        #: wire dtypes (uint8) and run normalization on device, where it
+        #: fuses into the step for free (SURVEY hard-part #5; the H2D link
+        #: is the scarce resource, esp. on a tunneled runtime).
+        self._device_transform: Callable | None = None
 
     # -- constructors --------------------------------------------------------
 
@@ -147,7 +157,9 @@ class Dataset:
             for i in range(n):
                 yield _map_structure(lambda a: a[i], arrays)
 
-        return Dataset(factory, cardinality=n)
+        ds = Dataset(factory, cardinality=n)
+        ds._tensor_source = arrays
+        return ds
 
     @staticmethod
     def from_generator(gen_factory: Callable[[], Iterable]) -> "Dataset":
@@ -583,6 +595,7 @@ class Dataset:
         # A prefetch anywhere upstream keeps the chain marked, so the
         # DistributedDataset default wrap never double-buffers.
         ds._prefetched = self._prefetched
+        ds._device_transform = self._device_transform
         return ds
 
     def _replay_transform(self, transform: tuple[str, dict]) -> "Dataset":

@@ -77,6 +77,41 @@ class Trainer:
         self._metric_init_fn = None
         self._loss_acc_init_fn = None
         self._class_weight: Optional[dict] = None
+        #: Per-dataset jittable x-batch transforms (u8-over-the-wire
+        #: normalization split, data/vectorize.py) — trace-time constants
+        #: of the compiled steps, so a change invalidates the cache.
+        self._device_transform = None
+        self._eval_transform = None
+
+    @staticmethod
+    def _transform_key(t):
+        """Semantic identity for device transforms: scale transforms with
+        equal (op, scale) are the same program even when each
+        DistributedDataset built a fresh closure — comparing by object
+        identity would re-jit the step on EVERY fit()/evaluate() call."""
+        if t is None:
+            return None
+        op, k = getattr(t, "_op", None), getattr(t, "_scale", None)
+        return ("scale", op, k) if k is not None else id(t)
+
+    def _sync_device_transform(self, dist, *, role: str) -> None:
+        """Adopt ``dist``'s device transform for the given step family,
+        recompiling if it changed. Train and eval keep separate slots so a
+        fit with a u8-transform training set and a plain validation set
+        doesn't thrash the caches every epoch."""
+        t = getattr(dist, "device_transform", None)
+        if role == "train":
+            if self._transform_key(t) != self._transform_key(
+                    self._device_transform):
+                self._device_transform = t
+                self._train_step = None
+                self._multi_step = None
+        else:
+            if self._transform_key(t) != self._transform_key(
+                    self._eval_transform):
+                self._eval_transform = t
+                self._eval_step = None
+                self._predict_fn = None
 
     def _maybe_invalidate_for_policy(self) -> None:
         """Drop cached compiled steps when the global mixed-precision policy
@@ -189,8 +224,14 @@ class Trainer:
         import jax.numpy as jnp
 
         class_weight = self._class_weight
+        device_transform = self._device_transform
 
         def step(params, state, opt_state, metric_states, loss_acc, x, y, rng):
+            if device_transform is not None:
+                # The device half of the wire-dtype split (u8 arrives, scale
+                # happens here) — fused by XLA into the first conv/matmul.
+                x = device_transform(x)
+
             def loss_fn(p):
                 logits, new_state = model.apply(p, state, x, training=True,
                                                 rng=rng)
@@ -310,6 +351,13 @@ class Trainer:
             self._class_weight = None
             self._train_step = None
             self._multi_step = None
+        if self._device_transform is not None:
+            # Same rule as class_weight: a prior fit's dataset-specific
+            # input transform (e.g. the u8 wire-dtype scale) must not leak
+            # into the public step — callers feed already-prepared batches.
+            self._device_transform = None
+            self._train_step = None
+            self._multi_step = None
         k = (steps_per_execution if steps_per_execution is not None
              else max(1, int(getattr(self.model, "steps_per_execution", 1))))
         if k > 1:
@@ -331,8 +379,11 @@ class Trainer:
     def _build_eval_step(self):
         model, loss_obj = self.model, self.model.loss
         metrics = tuple(model.metrics)
+        device_transform = self._eval_transform
 
         def step(params, state, metric_states, loss_acc, x, y):
+            if device_transform is not None:
+                x = device_transform(x)
             logits, _ = model.apply(params, state, x, training=False)
             loss = loss_obj(logits, y)
             new_metrics = tuple(
@@ -354,8 +405,16 @@ class Trainer:
         if isinstance(x, DistributedDataset):
             return x
         if isinstance(x, Dataset):
-            # The Keras-trainer auto-wrap (keras:src/backend/tensorflow/
-            # trainer.py:750-755): honors the dataset's auto-shard options.
+            # Device-residency promotion first (data/vectorize.py): an
+            # HBM-sized reference-shaped chain uploads once and streams only
+            # index vectors — the TPU-idiomatic delivery. Falls through to
+            # the Keras-trainer auto-wrap (keras:src/backend/tensorflow/
+            # trainer.py:750-755), which honors the auto-shard options.
+            from tpu_dist.data import vectorize
+
+            promoted = vectorize.try_promote_to_device(x)
+            if promoted is not None:
+                return promoted.bind_strategy(self.strategy)
             return DistributedDataset(x, self.strategy)
         if isinstance(x, (tuple, list)) and len(x) == 2:
             ds = Dataset.from_tensor_slices(tuple(np.asarray(a) for a in x))
@@ -413,12 +472,15 @@ class Trainer:
             self._class_weight = class_weight
             self._train_step = None
             self._multi_step = None
+        # Distribute BEFORE building steps: the dataset may carry a device
+        # transform that is a trace-time constant of the compiled step.
+        dist = self._distribute(x)
+        self._sync_device_transform(dist, role="train")
         if self._train_step is None:
             self._train_step = self._build_train_step()
         if (getattr(self.model, "steps_per_execution", 1) > 1
                 and self._multi_step is None):
             self._multi_step = self._build_multi_step()
-        dist = self._distribute(x)
         if steps_per_epoch is None:
             steps_per_epoch = self._cardinality_of(dist)
             if steps_per_epoch is None:
@@ -634,6 +696,7 @@ class Trainer:
                      steps: Optional[int]) -> dict:
         """One evaluation pass over ``dist``; shared by evaluate() and the
         per-epoch validation hook of fit()."""
+        self._sync_device_transform(dist, role="eval")
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         v = self.variables
@@ -668,9 +731,23 @@ class Trainer:
         self.ensure_variables()
         self._maybe_invalidate_for_policy()
         model = self.model
+        is_array = isinstance(x, np.ndarray) or hasattr(x, "__array__")
+        t = None if is_array else getattr(
+            x, "device_transform", getattr(x, "_device_transform", None))
+        if self._transform_key(t) != self._transform_key(
+                self._eval_transform):
+            self._eval_transform = t
+            self._eval_step = None
+            self._predict_fn = None
         if self._predict_fn is None:
-            self._predict_fn = jax.jit(
-                lambda p, s, xb: model.apply(p, s, xb, training=False)[0])
+            dt = self._eval_transform
+
+            def fwd(p, s, xb):
+                if dt is not None:
+                    xb = dt(xb)
+                return model.apply(p, s, xb, training=False)[0]
+
+            self._predict_fn = jax.jit(fwd)
         if isinstance(x, np.ndarray) or hasattr(x, "__array__"):
             batches = [np.asarray(x)]
         else:
