@@ -560,18 +560,118 @@ def run_cpu_baseline() -> dict:
         }
     except Exception as e:
         r["breakdown"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    _attach_reference_ratio(r, include_tf_record=True)
+    return r
+
+
+def _attach_reference_ratio(r: dict, *, include_tf_record: bool = False,
+                            basis_suffix: str = "") -> None:
+    """Stamp reference_basis / reference rate / vs_reference onto a CPU
+    bench section — ONE definition of what 'vs_reference' means, shared by
+    the in-process and 2-process baselines."""
     tf_ref = measure_tf_reference()
     if tf_ref is not None:
         ref_rate = tf_ref["images_per_sec_per_core"]
         r["reference_basis"] = ("tf MultiWorkerMirroredStrategy 2-worker "
-                                "loopback measured on this host")
-        r["tf_reference"] = tf_ref
+                                "loopback measured on this host"
+                                + basis_suffix)
+        if include_tf_record:
+            r["tf_reference"] = tf_ref
     else:
         ref_rate = REFERENCE_CPU_IMG_PER_SEC_PER_CORE
         r["reference_basis"] = ("survey-hardware constant ~62 ms/step "
                                 "(SURVEY.md §3.5); tf unavailable here")
     r["reference_images_per_sec_per_core"] = round(ref_rate, 1)
     r["vs_reference"] = round(r["images_per_sec_per_core"] / ref_rate, 3)
+
+
+def run_cpu_baseline_2proc(timeout: float = 1200) -> dict:
+    """BASELINE.md config 3's LITERAL shape: two real OS processes, each
+    with a per-worker TF_CONFIG and ONE CPU device, synchronized through
+    the jax.distributed coordination service with per-step cross-process
+    all-reduces — the same topology the TF reference baseline was measured
+    in (benchmarks/tf_reference_bench.py). The like-for-like
+    ``cpu_baseline`` section instead emulates 2 devices inside one process
+    (in-process SPMD), which pays partition-threads-on-one-core costs a
+    real 2-process launch does not; this section settles which sync
+    mechanism the 0.x gap belongs to. One device per process also sidesteps
+    the XLA:CPU shared-pool rendezvous-starvation hazard
+    (trainer._bounded_dispatch), so the dispatch pipeline stays on."""
+    import socket
+
+    from tpu_dist.cluster.config import make_local_cluster
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    configs = make_local_cluster(2, base_port=port)
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "twoproc_worker.py")
+    procs = []
+    for cfg in configs:
+        env = dict(os.environ)
+        env.update({
+            "TF_CONFIG": json.dumps(cfg),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PALLAS_AXON_POOL_IPS": "",
+            "PYTHONPATH": os.path.dirname(os.path.abspath(__file__))
+            + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, script], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    results = []
+    try:
+        for i, p in enumerate(procs):
+            try:
+                out, err = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                raise RuntimeError(f"2proc worker {i} timed out")
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"2proc worker {i} rc={p.returncode}: {err[-500:]}")
+            payload = None
+            for line in out.splitlines():
+                if line.startswith("RESULT:"):
+                    payload = json.loads(line[len("RESULT:"):])
+            if payload is None:
+                raise RuntimeError(f"2proc worker {i} emitted no RESULT "
+                                   f"({out[-300:]!r})")
+            results.append(payload)
+    finally:
+        # A dead worker must take its sibling with it: the survivor would
+        # otherwise busy-wait in coordination-service connect on the shared
+        # single core, polluting every later bench section.
+        for q in procs:
+            if q.poll() is None:
+                q.kill()
+    r = {
+        "mode": "cpu_baseline_2proc_tf_config_loopback",
+        "workers": 2,
+        "per_worker": results,
+        # Collectives make the workers' step times near-identical; report
+        # the slower worker (the job runs at the laggard's pace).
+        "step_ms": max(w["step_ms"] for w in results),
+        "images_per_sec_per_core": min(
+            w["images_per_sec_per_core"] for w in results),
+        "topology_note": (
+            "2 real processes timeshare this host's ONE physical core. "
+            "r4 probes: the compiled step carries only 2 (tuple-packed) "
+            "all-reduces — XLA combines the 8 gradient tensors like TF's "
+            "bytes_per_pack — and a lone cross-process all-reduce costs "
+            "~4-5 ms; the dominant cost is jax's gloo CPU collectives "
+            "BUSY-POLLING while the peer computes, stealing ~half the "
+            "shared core (measured: compute runs ~2x slower with a "
+            "spinning peer; 2x(2x48 ms) matches the ~198 ms step). TF's "
+            "gRPC ring blocks in epoll instead of spinning, so its two "
+            "workers serialize cleanly at ~90 ms. With >=1 core per "
+            "worker (every real deployment) the spin overlaps nothing; "
+            "the in-process SPMD section above stays the like-for-like "
+            "number on this degenerate 1-core topology."),
+    }
+    _attach_reference_ratio(
+        r, basis_suffix=" — IDENTICAL topology to this section")
     return r
 
 
@@ -705,6 +805,7 @@ def driver_run() -> int:
             "transformer_lm", steps=32, warmup=16, global_batch=64, spe=16,
             precision_policy="mixed_bfloat16"),
         "cpu_baseline": run_cpu_baseline,
+        "cpu_baseline_2proc": run_cpu_baseline_2proc,
     }
     for name, fn in sections.items():
         try:
@@ -780,6 +881,8 @@ def driver_run() -> int:
             "lm_bf16_tokens_s_core": _pick("transformer_lm_bf16",
                                            "tokens_per_sec_per_core"),
             "cpu_vs_reference": cpu.get("vs_reference"),
+            "cpu_2proc_vs_reference": _pick("cpu_baseline_2proc",
+                                            "vs_reference"),
         },
         "extras_path": extras_path,
     }

@@ -1,0 +1,85 @@
+"""One worker of the 2-process TF_CONFIG loopback benchmark.
+
+The EXACT launch shape of the reference's headline demo
+(/root/reference/README.md:156-162: same script started once per worker
+with a per-worker TF_CONFIG) and of the measured TF baseline
+(benchmarks/tf_reference_bench.py: 2 real MWMS workers over loopback
+gRPC). bench.py's ``cpu_baseline_2proc`` section spawns two of these; the
+parent exports TF_CONFIG / JAX_PLATFORMS=cpu / 1 virtual device per
+process, so cross-worker synchronization happens through the REAL
+jax.distributed coordination service + per-step collectives — not the
+single-process SPMD emulation the like-for-like ``cpu_baseline`` measures.
+
+Pipeline shape mirrors the reference run: autoshard OFF, every worker
+draws its own independently-shuffled batch of 128 from its own full host
+stream (SURVEY.md §3.4), gradients all-reduced each step.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    warmup_steps = int(os.environ.get("TWOPROC_WARMUP_STEPS", "16"))
+    timed_steps = int(os.environ.get("TWOPROC_TIMED_STEPS", "60"))
+    windows = int(os.environ.get("TWOPROC_WINDOWS", "2"))
+    per_worker_batch = int(os.environ.get("TWOPROC_BATCH", "128"))
+
+    import jax
+
+    import tpu_dist as td
+    from tpu_dist.data.native import native_pipeline
+    from tpu_dist.data.pipeline import AutoShardPolicy, Options
+
+    td.cluster.initialize()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 1, jax.local_device_count()
+
+    strategy = td.MultiWorkerMirroredStrategy(td.CollectiveCommunication.AUTO)
+    assert strategy.num_replicas_in_sync == 2
+
+    # Per-worker full stream, batch 128, autoshard OFF — the reference's
+    # consumption shape (each worker's batch is its own contribution; the
+    # effective global batch is 2x128 distinct samples).
+    ds = native_pipeline("mnist", global_batch_size=per_worker_batch,
+                         seed=1234 + jax.process_index(),
+                         synthetic_size=8192)
+    opts = Options()
+    opts.experimental_distribute.auto_shard_policy = AutoShardPolicy.OFF
+    ds = ds.with_options(opts)
+
+    with strategy.scope():
+        model = td.models.build_and_compile_cnn_model(learning_rate=0.001)
+
+    # Warmup pays compile + bring-up; the barrier puts every worker at the
+    # same start line so the timed windows measure synced steady state.
+    model.fit(ds, epochs=1, steps_per_epoch=warmup_steps, verbose=0)
+    td.cluster.barrier("twoproc_bench_start")
+    window_ms = []
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        model.fit(ds, epochs=1, steps_per_epoch=timed_steps, verbose=0)
+        window_ms.append((time.perf_counter() - t0) / timed_steps * 1e3)
+    td.cluster.barrier("twoproc_bench_end")
+
+    step_ms = min(window_ms)
+    result = {
+        "process_index": jax.process_index(),
+        "workers": 2,
+        "per_worker_batch": per_worker_batch,
+        "timed_steps": timed_steps,
+        "windows": windows,
+        "window_step_ms": [round(w, 4) for w in window_ms],
+        "step_ms": round(step_ms, 4),
+        # Per-core rate on the same basis as the TF reference measurement:
+        # one worker stream of 128 img/step on one core.
+        "images_per_sec_per_core": round(per_worker_batch / step_ms * 1e3, 1),
+    }
+    print("RESULT:" + json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

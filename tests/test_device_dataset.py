@@ -112,11 +112,23 @@ class TestShuffleSemantics:
         _, yb = b.next_batch()
         np.testing.assert_array_equal(np.asarray(ya), np.asarray(yb))
 
-    def test_iter_is_sequential_unshuffled(self, strategy):
+    def test_iter_honors_shuffle_flag(self, strategy):
+        # shuffle=False: sequential source order. shuffle=True: a full
+        # permutation per pass — bounded evaluate(steps=K) must see a
+        # random subset, not the first K source-order batches (r4).
         x, y = _toy(64)
-        ds = DeviceDataset(x, y, global_batch_size=16, strategy=strategy)
+        ds = DeviceDataset(x, y, global_batch_size=16, strategy=strategy,
+                           shuffle=False)
         got = [int(v) for _, yb in ds for v in np.asarray(yb)]
         assert got == [int(v) for v in y]
+
+        shuffled = DeviceDataset(x, y, global_batch_size=16,
+                                 strategy=strategy, shuffle=True)
+        g1 = [int(v) for _, yb in shuffled for v in np.asarray(yb)]
+        g2 = [int(v) for _, yb in shuffled for v in np.asarray(yb)]
+        assert sorted(g1) == sorted(got) and sorted(g2) == sorted(got)
+        assert g1 != got or g2 != got  # at least one pass reordered
+        assert g1 != g2  # fresh permutation per pass
 
 
 class TestFitIntegration:

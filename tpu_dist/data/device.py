@@ -201,13 +201,23 @@ class DeviceDataset:
         return self._gather_stack(self._dx, self._dy, idx)
 
     def __iter__(self) -> Iterator:
-        """One sequential, unshuffled pass — the evaluate() path."""
+        """One full pass — the evaluate() path. Honors the dataset's
+        shuffle flag (fresh permutation per pass): a bounded
+        ``evaluate(steps=K)`` on a shuffled dataset must score a random
+        subset, not the first K source-order batches (class-sorted sources
+        would silently bias the metrics). ``shuffle=False`` keeps the
+        sequential order."""
         self._ensure_placed()
         if self._gather_batch is None:
             self._gather_batch = self._build_gather(stacked=False)
+        if self._shuffle:
+            rng = np.random.default_rng(self._seed + self._epoch)
+            self._epoch += 1
+            order = rng.permutation(self._n).astype(np.int32)
+        else:
+            order = np.arange(self._n, dtype=np.int32)
         for s in range(self.cardinality()):
-            idx = np.arange(s * self._batch, (s + 1) * self._batch,
-                            dtype=np.int32)
+            idx = order[s * self._batch:(s + 1) * self._batch]
             yield self._gather_batch(self._dx, self._dy, idx)
 
 

@@ -46,7 +46,8 @@ class DistributedDataset:
 
     def __init__(self, dataset: Dataset, strategy,
                  policy: AutoShardPolicy | None = None,
-                 prefetch: int | None = 2):
+                 prefetch: int | None = 2,
+                 allow_device_transform: bool = False):
         import jax
 
         self._strategy = strategy
@@ -65,12 +66,17 @@ class DistributedDataset:
                 self._policy, pre_batched=True)
         # Vectorized chain rewrite (the Grappler map_and_batch/vectorize
         # analog, data/vectorize.py): index math + batched gathers replace
-        # the per-element generator walk when the chain's shape allows —
-        # including the u8-over-the-wire + scale-on-device fusion. Applied
-        # AFTER sharding so the rewritten chain includes the shard op.
+        # the per-element generator walk when the chain's shape allows.
+        # The u8-over-the-wire + scale-on-device split is only taken when
+        # the consumer declares it will apply device transforms (the
+        # Trainer does; a user iterating this object in a custom loop has
+        # no such obligation, so their batches must stay host-normalized
+        # float32).
         from tpu_dist.data import vectorize
 
-        fast = vectorize.try_rewrite(self._local)
+        fast = vectorize.try_rewrite(
+            self._local,
+            defer_scale_to_device=None if allow_device_transform else False)
         if fast is not None:
             self._local = fast
         # Host input off the step critical path by default (SURVEY.md §3.4 /

@@ -595,7 +595,14 @@ class Dataset:
         # A prefetch anywhere upstream keeps the chain marked, so the
         # DistributedDataset default wrap never double-buffers.
         ds._prefetched = self._prefetched
-        ds._device_transform = self._device_transform
+        # The device transform composes AFTER placement, so it survives
+        # only stream-shape ops; an element transform (map/filter/...)
+        # would otherwise see the compact wire dtype AND still get the
+        # deferred scale applied on top of its own output.
+        if transform is not None and transform[0] in (
+                "prefetch", "with_options", "repeat", "take", "skip",
+                "shard", "batch"):
+            ds._device_transform = self._device_transform
         return ds
 
     def _replay_transform(self, transform: tuple[str, dict]) -> "Dataset":

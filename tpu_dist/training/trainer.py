@@ -415,7 +415,11 @@ class Trainer:
             promoted = vectorize.try_promote_to_device(x)
             if promoted is not None:
                 return promoted.bind_strategy(self.strategy)
-            return DistributedDataset(x, self.strategy)
+            # allow_device_transform: the trainer applies dataset device
+            # transforms inside its compiled steps (_sync_device_transform),
+            # so the u8-wire split is safe here — unlike user-iterated wraps.
+            return DistributedDataset(x, self.strategy,
+                                      allow_device_transform=True)
         if isinstance(x, (tuple, list)) and len(x) == 2:
             ds = Dataset.from_tensor_slices(tuple(np.asarray(a) for a in x))
             return DistributedDataset(ds.batch(32), self.strategy)
@@ -748,7 +752,7 @@ class Trainer:
                 return model.apply(p, s, xb, training=False)[0]
 
             self._predict_fn = jax.jit(fwd)
-        if isinstance(x, np.ndarray) or hasattr(x, "__array__"):
+        if is_array:
             batches = [np.asarray(x)]
         else:
             batches = [b[0] if isinstance(b, tuple) else b for b in x]
