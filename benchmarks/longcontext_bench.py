@@ -41,7 +41,7 @@ import sys
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-OUT_PATH = os.path.join(HERE, "longcontext_r3.json")
+OUT_PATH = os.path.join(HERE, "longcontext_r4.json")
 sys.path.insert(0, os.path.dirname(HERE))
 
 
@@ -151,7 +151,8 @@ def run_mesh_sweep(lengths=(2048, 4096, 8192, 16384, 32768, 65536),
             "causal": True, "rows": rows}
 
 
-def run_tpu_seq_sweep(lengths=(512, 1024, 2048, 4096), batch_tokens=32768,
+def run_tpu_seq_sweep(lengths=(512, 1024, 2048, 4096, 8192, 16384),
+                      batch_tokens=32768,
                       bf16=True):
     """Single-chip LM step benchmark across sequence lengths, flash vs
     dense attention (TPU_DIST_FLASH=0 escape hatch), at constant tokens

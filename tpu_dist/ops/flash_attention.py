@@ -20,23 +20,27 @@ Design (forward):
     ~1.1 us fixed cost per grid program, so at the LM's shape (B*H = 512,
     L = 512) a one-head-per-program grid spent more time on program
     overhead than on math; grouping divides program count by G;
-  * grid = (B*H/G, L/TQ); each program holds one query tile [G, TQ, D]
-    and streams the group's WHOLE K/V (VMEM-resident, [G, L, D] each)
-    through an inner loop over key tiles, folding each [G, TQ, TK] score
-    tile into the running (row-max, normalizer, unnormalized-output)
-    accumulator;
+  * grid = (B*H/G, L/TQ, L/TK) with the KEY axis innermost: Pallas's
+    pipeline streams one [G, TK, D] K/V tile at a time from HBM
+    (double-buffered DMA) while the (row-max, normalizer, unnormalized
+    output) accumulator lives in VMEM scratch across the key-axis steps.
+    Residency is per-TILE, not per-sequence — r3's design kept the whole
+    [G, L, D] K/V resident, so growing L collapsed the head group to 1
+    and MFU with it (34.6 % -> 10.9 % over seq 512 -> 8192, the r3
+    longcontext sweep); with streaming, the layout is L-independent;
   * matmuls keep the INPUT dtype on the MXU (bf16 stays bf16) with fp32
     accumulation via ``preferred_element_type``; only the softmax
     statistics and accumulators are fp32 — forcing operands to fp32 would
     halve bf16 MXU throughput for nothing;
-  * causal masking skips strictly-future key tiles with a ``lax.cond``
-    inside the STATIC loop (measured faster than a dynamic trip count,
-    which blocks unrolling) — ~half the FLOPs of dense, matching the
-    dead-block skip in the ring path;
+  * causal masking skips strictly-future key tiles with ``pl.when`` on
+    the key-axis grid step — ~half the FLOPs of dense, matching the
+    dead-block skip in the ring path (their tile DMA rides the pipeline
+    either way; FLOPs, not bandwidth, are the scarce resource here);
   * the log-sum-exp per query row is written out as a residual;
   * G and the tile sizes are picked per call against a VMEM budget:
     bigger tiles amortize per-program overhead, bounded by the [G, TQ, TK]
-    fp32 score tile's footprint and the resident K/V bytes.
+    fp32 score tile's footprint plus the double-buffered per-tile streams
+    and the scratch accumulator (all L-independent).
 
 Backward recomputes probabilities from the saved lse (the flash trade:
 O(L) residual memory instead of O(L^2) saved scores) in two kernels:
@@ -62,18 +66,21 @@ import jax.numpy as jnp
 
 #: Tile-size candidates, largest first. Square [T, T] score tiles: the v5e
 #: sweep showed causal skipping needs TK <= TQ to bite, and MXU efficiency
-#: wants the biggest tile that compiles — (g=4, 512, 512) hit 82 TF/s at
-#: the LM shape where (8, 128, 512) sat at ~11.
-_T_CANDIDATES = (512, 256, 128)
+#: wants the biggest tile that compiles. r4 (streaming layout) re-swept
+#: with 1024 in the pool: it wins at every L >= 1024 it divides
+#: (+6-13 % tok/s; seq 8192 went 22.8 -> 27.1 % MFU with the bigger
+#: budget below), while 512 keeps the short-sequence crown.
+_T_CANDIDATES = (1024, 512, 256, 128)
 _G_CANDIDATES = (8, 4, 2, 1)
 
 #: VMEM bytes the layout estimator may plan against. The physical VMEM is
 #: 128 MB; XLA's default SCOPED limit is 16 MB, which the kernel raises via
-#: vmem_limit_bytes below — the planning budget stays deliberately tighter
-#: than the raised limit because the stack estimate undercounts Mosaic's
-#: live temporaries by a few score tiles (measured: the (g=4, t=512)
-#: L=1024 bf16 config estimates 12.6 MB but allocates 17.1 MB).
-_VMEM_BUDGET = 13 * 1024 * 1024
+#: vmem_limit_bytes below. r3's resident-K/V design throttled this to
+#: 13 MB; with per-tile streaming (r4) the estimate tracks reality much
+#: closer, and the 26 MB re-calibration lets the backward pair take
+#: [1024, 1024] score tiles (measured: seq 16384 22.6 -> 25.6 % MFU)
+#: while staying far under the raised scoped limit.
+_VMEM_BUDGET = 26 * 1024 * 1024
 
 #: Scoped-VMEM ceiling passed to Mosaic (< the 128 MB physical so XLA keeps
 #: room for its own buffers). Without this, shapes whose true footprint
@@ -92,20 +99,25 @@ def _compiler_params(interpret):
 
 
 def _fits(g, t, ln, d, itemsize, n_score):
-    """VMEM estimate: double-buffered resident K/V streams plus ~n_score
-    live fp32 [G, T, T] score-shaped stack temporaries (s/p/dp/ds and the
-    dot operands Mosaic keeps alive; 2.5 measured adequate for the fwd
-    kernel, 4 for the backward pair)."""
-    resident = 2 * g * ln * d * itemsize * 2
+    """VMEM estimate, L-INDEPENDENT by design: the pipeline keeps ~2
+    double-buffered [G, T, D] tiles per streamed operand (K and V — q/o
+    and the stats are one tile each) plus ~n_score live fp32 [G, T, T]
+    score-shaped stack temporaries (s/p/dp/ds and the dot operands Mosaic
+    keeps alive; 2.5 measured adequate for the fwd kernel, 4 for the
+    backward pair) plus the fp32 scratch accumulator [G, T, D]."""
+    tiles = 6 * g * t * d * itemsize
+    scratch = g * t * d * 4 + 2 * g * t * 4
     stack = n_score * g * t * t * 4
-    return resident + stack <= _VMEM_BUDGET
+    return tiles + scratch + stack <= _VMEM_BUDGET
 
 
 def _pick_layout(bh: int, ln: int, d: int, itemsize: int, n_score: float):
     """Choose (G, T): the largest square tile that divides L, then the
     largest head group that fits the budget. Tile size dominates (MXU
     shapes); the group then amortizes the ~1.1 us/program fixed cost.
-    Returns None if L has no 128-multiple tiling that fits."""
+    Returns None if L has no 128-multiple tiling that fits. Streaming
+    makes the choice independent of L, so the layout (and the MFU) no
+    longer degrades as sequences grow."""
     for t in _T_CANDIDATES:
         if ln % t:
             continue
@@ -133,18 +145,34 @@ def _bdot(a, b, contract, out_dtype=jnp.float32):
 # -- forward ------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                causal, scale, nk, tq, tk):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                *, causal, scale, nk, tq, tk):
+    """One (head-group, query-tile, KEY-tile) grid step. The key axis is
+    the innermost grid dimension: Pallas streams each [G, TK, D] K/V tile
+    from HBM while the online-softmax state (m, l, acc) persists in VMEM
+    scratch across the key steps of one query tile."""
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[:]                                           # (G, TQ, D)
-    g, _, d = q.shape
+    j = pl.program_id(2)
 
-    def consume(j, carry):
-        m, l, acc = carry
-        k_blk = k_ref[:, pl.ds(j * tk, tk), :]
-        v_blk = v_ref[:, pl.ds(j * tk, tk), :]
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Key tiles strictly past this query tile's diagonal are fully masked —
+    # skip their matmuls (the same dead-block cut as the ring path). Their
+    # DMA is part of the pipeline either way; the FLOPs are the scarce
+    # resource here.
+    live = (j * tk < (qi + 1) * tq) if causal else True
+
+    @pl.when(live)
+    def _consume():
+        q = q_ref[:]                                       # (G, TQ, D)
+        k_blk = k_ref[:]                                   # (G, TK, D)
+        v_blk = v_ref[:]
         s = _bdot(q, k_blk, ((2,), (2,))) * scale          # (G, TQ, TK) f32
         if causal:
             s = _mask_tile(s, qi * tq, j * tk)
@@ -153,31 +181,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         # itself becomes finite after any unmasked entry (causal tiles at or
         # before the diagonal always contain the self position), so no
         # -inf - -inf NaN path exists here.
+        m = m_ref[:]
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                             # (G, TQ, TK) f32
         corr = jnp.exp(m - m_new)                          # (G, TQ, 1)
-        l_new = l * corr + p.sum(axis=-1, keepdims=True)
-        acc_new = acc * corr + _bdot(p.astype(v_blk.dtype), v_blk,
-                                     ((2,), (1,)))
-        return m_new, l_new, acc_new
+        m_ref[:] = m_new
+        l_ref[:] = l_ref[:] * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + _bdot(p.astype(v_blk.dtype),
+                                               v_blk, ((2,), (1,)))
 
-    def step(j, carry):
-        if not causal:
-            return consume(j, carry)
-        # Key tiles strictly past this query tile's diagonal are fully
-        # masked — skip their matmuls (same dead-block cut as the ring
-        # path). Static trip count + cond measured faster than a dynamic
-        # fori_loop bound, which blocks Mosaic's unrolling.
-        return jax.lax.cond(j * tk < (qi + 1) * tq, consume,
-                            lambda _, c: c, j, carry)
-
-    m0 = jnp.full((g, tq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((g, tq, 1), jnp.float32)
-    a0 = jnp.zeros((g, tq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, nk, step, (m0, l0, a0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[:] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l_safe)
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[:] = m_ref[:] + jnp.log(l_safe)
 
 
 def _fwd(q3, k3, v3, causal, scale, interpret, g, tq, tk):
@@ -192,24 +209,29 @@ def _fwd(q3, k3, v3, causal, scale, interpret, g, tq, tk):
                                nk=nk, tq=tq, tk=tk)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh // g, nq),
+        grid=(bh // g, nq, nk),
         in_specs=[
-            pl.BlockSpec((g, tq, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((g, tq, d), lambda b, i, j: (b, i, 0),
                          memory_space=space),
-            pl.BlockSpec((g, ln, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((g, tk, d), lambda b, i, j: (b, j, 0),
                          memory_space=space),
-            pl.BlockSpec((g, ln, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((g, tk, d), lambda b, i, j: (b, j, 0),
                          memory_space=space),
         ],
         out_specs=[
-            pl.BlockSpec((g, tq, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((g, tq, d), lambda b, i, j: (b, i, 0),
                          memory_space=space),
-            pl.BlockSpec((g, tq, 1), lambda b, i: (b, i, 0),
+            pl.BlockSpec((g, tq, 1), lambda b, i, j: (b, i, 0),
                          memory_space=space),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, ln, d), q3.dtype),
             jax.ShapeDtypeStruct((bh, ln, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((g, tq, 1), jnp.float32),
+            pltpu.VMEM((g, tq, 1), jnp.float32),
+            pltpu.VMEM((g, tq, d), jnp.float32),
         ],
         interpret=interpret,
         compiler_params=_compiler_params(interpret),
@@ -220,20 +242,29 @@ def _fwd(q3, k3, v3, causal, scale, interpret, g, tq, tk):
 # -- backward: dq -------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal, scale, nk, tq, tk):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc_ref, *, causal, scale, nk, tq, tk):
+    """Grid (BH/G, L/TQ, L/TK), key axis innermost and streamed; the dq
+    accumulator persists in VMEM scratch across the key steps."""
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[:]                                           # (G, TQ, D)
-    do = do_ref[:]                                         # (G, TQ, D)
-    lse = lse_ref[:]                                       # (G, TQ, 1) f32
-    delta = delta_ref[:]                                   # (G, TQ, 1) f32
-    g, _, d = q.shape
+    j = pl.program_id(2)
 
-    def consume(j, dq):
-        k_blk = k_ref[:, pl.ds(j * tk, tk), :]
-        v_blk = v_ref[:, pl.ds(j * tk, tk), :]
+    @pl.when(j == 0)
+    def _init():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    live = (j * tk < (qi + 1) * tq) if causal else True
+
+    @pl.when(live)
+    def _consume():
+        q = q_ref[:]                                       # (G, TQ, D)
+        do = do_ref[:]                                     # (G, TQ, D)
+        lse = lse_ref[:]                                   # (G, TQ, 1) f32
+        delta = delta_ref[:]                               # (G, TQ, 1) f32
+        k_blk = k_ref[:]                                   # (G, TK, D)
+        v_blk = v_ref[:]
         s = _bdot(q, k_blk, ((2,), (2,))) * scale
         if causal:
             # Masked entries: s = -inf -> p = exp(-inf - lse) = 0 exactly.
@@ -241,59 +272,58 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         p = jnp.exp(s - lse)                               # (G, TQ, TK) f32
         dp = _bdot(do, v_blk, ((2,), (2,)))                # (G, TQ, TK) f32
         ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
-        return dq + _bdot(ds, k_blk, ((2,), (1,)))
+        dq_acc_ref[:] = dq_acc_ref[:] + _bdot(ds, k_blk, ((2,), (1,)))
 
-    def step(j, dq):
-        if not causal:
-            return consume(j, dq)
-        return jax.lax.cond(j * tk < (qi + 1) * tq, consume,
-                            lambda _, c: c, j, dq)
-
-    dq = jax.lax.fori_loop(0, nk, step,
-                           jnp.zeros((g, tq, d), jnp.float32))
-    dq_ref[:] = dq.astype(dq_ref.dtype)
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[:] = dq_acc_ref[:].astype(dq_ref.dtype)
 
 
 # -- backward: dk, dv ---------------------------------------------------------
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, causal, scale, nq, tq, tk):
+                dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                causal, scale, nq, tq, tk):
+    """Grid (BH/G, L/TK, L/TQ): KEY tile per middle index, QUERY axis
+    innermost and streamed (q/do/lse/delta tiles DMA per step); dk/dv
+    accumulate in VMEM scratch."""
     import jax.experimental.pallas as pl
 
     ki = pl.program_id(1)
-    k = k_ref[:]                                           # (G, TK, D)
-    v = v_ref[:]                                           # (G, TK, D)
-    g, _, d = k.shape
+    i = pl.program_id(2)
 
-    def consume(i, carry):
-        dk, dv = carry
-        q_blk = q_ref[:, pl.ds(i * tq, tq), :]
-        do_blk = do_ref[:, pl.ds(i * tq, tq), :]
-        lse_blk = lse_ref[:, pl.ds(i * tq, tq), :]          # (G, TQ, 1)
-        delta_blk = delta_ref[:, pl.ds(i * tq, tq), :]
-        s = _bdot(q_blk, k, ((2,), (2,))) * scale           # (G, TQ, TK)
+    @pl.when(i == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    # Query tiles strictly before this key tile's diagonal see none of
+    # these keys — skip them.
+    live = ((i + 1) * tq > ki * tk) if causal else True
+
+    @pl.when(live)
+    def _consume():
+        k = k_ref[:]                                       # (G, TK, D)
+        v = v_ref[:]
+        q_blk = q_ref[:]                                   # (G, TQ, D)
+        do_blk = do_ref[:]
+        lse_blk = lse_ref[:]                               # (G, TQ, 1)
+        delta_blk = delta_ref[:]
+        s = _bdot(q_blk, k, ((2,), (2,))) * scale          # (G, TQ, TK)
         if causal:
             s = _mask_tile(s, i * tq, ki * tk)
-        p = jnp.exp(s - lse_blk)                            # (G, TQ, TK) f32
-        dv_new = dv + _bdot(p.astype(do_blk.dtype), do_blk, ((1,), (1,)))
-        dp = _bdot(do_blk, v, ((2,), (2,)))                 # (G, TQ, TK)
+        p = jnp.exp(s - lse_blk)                           # (G, TQ, TK) f32
+        dv_acc_ref[:] = dv_acc_ref[:] + _bdot(
+            p.astype(do_blk.dtype), do_blk, ((1,), (1,)))
+        dp = _bdot(do_blk, v, ((2,), (2,)))                # (G, TQ, TK)
         ds = (p * (dp - delta_blk) * scale).astype(q_blk.dtype)
-        dk_new = dk + _bdot(ds, q_blk, ((1,), (1,)))        # (G, TK, D)
-        return dk_new, dv_new
+        dk_acc_ref[:] = dk_acc_ref[:] + _bdot(ds, q_blk, ((1,), (1,)))
 
-    def step(i, carry):
-        if not causal:
-            return consume(i, carry)
-        # Query tiles strictly before this key tile's diagonal see none of
-        # these keys — skip them.
-        return jax.lax.cond((i + 1) * tq > ki * tk, consume,
-                            lambda _, c: c, i, carry)
-
-    z = jnp.zeros((g, k.shape[1], d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, nq, step, (z, z))
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    @pl.when(i == nq - 1)
+    def _finalize():
+        dk_ref[:] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 # -- backward: fused single-tile dq, dk, dv -----------------------------------
@@ -335,15 +365,6 @@ def _bwd(q3, k3, v3, o3, lse, g3, causal, scale, interpret, g, tq, tk):
     delta = jnp.sum(g3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1, keepdims=True)                  # (BH, L, 1)
 
-    qtile_spec = pl.BlockSpec((g, tq, d), lambda b, i: (b, i, 0),
-                              memory_space=space)
-    full_spec = pl.BlockSpec((g, ln, d), lambda b, i: (b, 0, 0),
-                             memory_space=space)
-    stat_tile = pl.BlockSpec((g, tq, 1), lambda b, i: (b, i, 0),
-                             memory_space=space)
-    stat_full = pl.BlockSpec((g, ln, 1), lambda b, i: (b, 0, 0),
-                             memory_space=space)
-
     if nq == 1 and nk == 1:
         return pl.pallas_call(
             functools.partial(_dqkv_single_kernel, causal=causal,
@@ -362,29 +383,42 @@ def _bwd(q3, k3, v3, o3, lse, g3, causal, scale, interpret, g, tq, tk):
             compiler_params=_compiler_params(interpret),
         )(q3, k3, v3, g3, lse, delta)
 
+    # dq: query tile per middle index, key axis innermost (streamed).
+    qtile = pl.BlockSpec((g, tq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=space)
+    ktile_j = pl.BlockSpec((g, tk, d), lambda b, i, j: (b, j, 0),
+                           memory_space=space)
+    stat_q = pl.BlockSpec((g, tq, 1), lambda b, i, j: (b, i, 0),
+                          memory_space=space)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale, nk=nk,
                           tq=tq, tk=tk),
-        grid=(bh // g, nq),
-        in_specs=[qtile_spec, full_spec, full_spec, qtile_spec, stat_tile,
-                  stat_tile],
-        out_specs=qtile_spec,
+        grid=(bh // g, nq, nk),
+        in_specs=[qtile, ktile_j, ktile_j, qtile, stat_q, stat_q],
+        out_specs=qtile,
         out_shape=jax.ShapeDtypeStruct((bh, ln, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((g, tq, d), jnp.float32)],
         interpret=interpret,
         compiler_params=_compiler_params(interpret),
     )(q3, k3, v3, g3, lse, delta)
 
-    ktile_spec = pl.BlockSpec((g, tk, d), lambda b, i: (b, i, 0),
-                              memory_space=space)
+    # dk/dv: key tile per middle index, QUERY axis innermost (streamed).
+    ktile = pl.BlockSpec((g, tk, d), lambda b, ki, i: (b, ki, 0),
+                         memory_space=space)
+    qtile_i = pl.BlockSpec((g, tq, d), lambda b, ki, i: (b, i, 0),
+                           memory_space=space)
+    stat_i = pl.BlockSpec((g, tq, 1), lambda b, ki, i: (b, i, 0),
+                          memory_space=space)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale, nq=nq,
                           tq=tq, tk=tk),
-        grid=(bh // g, nk),
-        in_specs=[full_spec, ktile_spec, ktile_spec, full_spec, stat_full,
-                  stat_full],
-        out_specs=[ktile_spec, ktile_spec],
+        grid=(bh // g, nk, nq),
+        in_specs=[qtile_i, ktile, ktile, qtile_i, stat_i, stat_i],
+        out_specs=[ktile, ktile],
         out_shape=[jax.ShapeDtypeStruct((bh, ln, d), k3.dtype),
                    jax.ShapeDtypeStruct((bh, ln, d), v3.dtype)],
+        scratch_shapes=[pltpu.VMEM((g, tk, d), jnp.float32),
+                        pltpu.VMEM((g, tk, d), jnp.float32)],
         interpret=interpret,
         compiler_params=_compiler_params(interpret),
     )(q3, k3, v3, g3, lse, delta)
@@ -472,6 +506,22 @@ def flash_attention(q, k, v, *, causal: bool = False, scale: float,
     o = _flash(fold(q), fold(k), fold(v), causal, scale, interpret,
                resolve(2.5), resolve(4.0))
     return o.reshape(b, h, ln, d)
+
+
+def analytic_train_flops(batch: int, heads: int, seq_len: int,
+                         head_dim: int, *, causal: bool = True) -> float:
+    """Model FLOPs of one attention layer's train step (fwd + 2x bwd, the
+    standard MFU convention — the backward RE-computation of scores the
+    flash trade makes is deliberately NOT counted; it is overhead, not
+    model math). Needed because the fused kernel is an XLA custom call,
+    which ``cost_analysis()`` scores as ZERO flops — without this
+    correction a flash program's reported MFU decays with L purely as an
+    accounting artifact (the r3 longcontext sweep's 34.6 % -> 10.9 %
+    "decay" was mostly this). Causal counts the half the kernel actually
+    computes (dead blocks are skipped)."""
+    fwd = 4.0 * batch * heads * seq_len * seq_len * head_dim
+    total = 3.0 * fwd
+    return total * (0.5 if causal else 1.0)
 
 
 def use_flash(q) -> bool:
