@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -52,17 +54,24 @@ def test_dryrun_multichip_direct_call_after_jax_init():
     assert "DRIVER-OK" in proc.stdout
 
 
-def test_dryrun_multichip_child_invocation():
+@pytest.mark.parametrize("n,timeout", [
+    (4, 600),
+    # A quarter of BASELINE.md's 32-core story ran at n=8 since r1; the
+    # 16-device point holds the next doubling in the suite (r4).
+    (16, 900),
+])
+def test_dryrun_multichip_child_invocation(n, timeout):
     # Exactly what the re-exec runs: ``python __graft_entry__.py n`` with the
-    # recursion guard set — must provision its own virtual mesh and pass.
+    # recursion guard set — must provision its own virtual mesh and pass
+    # (DP fit + ring attention over data x seq + hybrid DP x TP).
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["TPU_DIST_DRYRUN_CHILD"] = "1"
     proc = subprocess.run(
-        [sys.executable, os.path.join(REPO, "__graft_entry__.py"), "4"],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+        [sys.executable, os.path.join(REPO, "__graft_entry__.py"), str(n)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
     assert proc.returncode == 0, proc.stderr[-4000:]
-    assert "dryrun_multichip(4): OK" in proc.stdout
+    assert f"dryrun_multichip({n}): OK" in proc.stdout
 
 
 def test_dryrun_multichip_inline_when_devices_suffice():
@@ -92,3 +101,4 @@ def test_entry_compiles_single_chip():
         extra_env={"JAX_PLATFORMS": "cpu"})
     assert proc.returncode == 0, proc.stderr[-4000:]
     assert "ENTRY-OK" in proc.stdout
+

@@ -475,3 +475,55 @@ emit({"process_index": jax.process_index(),
                                        rtol=2e-5, atol=2e-5)
         assert results[0].result["post_restore"] == \
             results[1].result["post_restore"]
+
+    def test_hybrid_dp_tp_four_processes(self):
+        # The 32-core-story stand-in at the process level (VERDICT r3 #4):
+        # FOUR real processes on the data axis, model axis intra-process —
+        # an 8-device global mesh {data: 4, model: 2}. Sync semantics and
+        # Megatron placement must both survive the wider topology.
+        body = """
+import numpy as np
+import jax
+import tpu_dist as td
+from jax.sharding import PartitionSpec as P
+from tpu_dist.models.transformer import build_transformer_lm
+
+td.cluster.initialize()
+assert jax.process_count() == 4 and jax.local_device_count() == 2
+strategy = td.MultiWorkerMirroredStrategy(
+    axis_shapes={"data": 4, "model": 2})
+assert strategy.num_replicas_in_sync == 4
+
+VOCAB, SEQ = 32, 16
+seq = np.arange(256) * 3 % VOCAB
+xs = np.stack([seq[i:i + SEQ] for i in range(0, 192, 4)]).astype(np.int64)
+ys = np.stack([seq[i + 1:i + SEQ + 1]
+               for i in range(0, 192, 4)]).astype(np.int64)
+ds = td.data.Dataset.from_tensor_slices((xs, ys)).batch(16).repeat()
+
+with strategy.scope():
+    model = build_transformer_lm(VOCAB, SEQ, d_model=32, depth=1,
+                                 num_heads=4)
+    model.compile(
+        loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+        optimizer=td.ops.Adam(1e-2))
+    hist = model.fit(ds, epochs=1, steps_per_epoch=3, verbose=0)
+
+wq = model.variables["params"]["block"]["residual"]["main"][
+    "multiheadattention"]["wq"]
+assert wq.sharding.spec == P(None, "model"), wq.sharding.spec
+local_shapes = sorted(s.data.shape for s in wq.addressable_shards)
+emit({"process_index": jax.process_index(),
+      "losses": [float(l) for l in hist.history["loss"]],
+      "wq_local_shapes": [list(s) for s in local_shapes]})
+"""
+        results = run_workers(
+            body, num_workers=4, timeout=420,
+            extra_env={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=2"})
+        assert_all_succeeded(results)
+        losses = [r.result["losses"] for r in results]
+        assert all(l == losses[0] for l in losses), losses
+        for r in results:
+            # 2 local devices, each holding a distinct 32x16 column shard
+            assert r.result["wq_local_shapes"] == [[32, 16]] * 2, r.result
