@@ -905,10 +905,10 @@ def driver_run() -> int:
     # The driver captures only the TAIL of stdout, so the one stdout JSON
     # line must stay short (r2 inlined every extra and the capture started
     # mid-JSON -> BENCH_r02 parsed=null). Headline scalars only here; the
-    # full record goes to benchmarks/bench_r3_full.json (path in the line).
+    # full record goes to the extras blob (path emitted in the line).
     extras_path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
-        "benchmarks", "bench_r3_full.json")
+        "benchmarks", "bench_r4_full.json")
     try:
         os.makedirs(os.path.dirname(extras_path), exist_ok=True)
         with open(extras_path, "w") as f:

@@ -57,9 +57,9 @@ class TestLayers:
     def test_pool_fast_path_matches_reduce_window(self):
         # The non-overlapping reshape+reduce pool (CPU-deficit fix, r3)
         # must equal lax.reduce_window exactly FORWARD, including odd
-        # extents (VALID crops the trailing row/col in both formulations),
-        # and in GRADIENT — ties included, via the custom one-hot VJP
-        # (see test_pool_tie_gradient_matches_reduce_window).
+        # extents (VALID crops the trailing row/col in both formulations).
+        # Gradients agree on tie-free inputs; tied maxima diverge by
+        # DOCUMENTED design (see test_pool_tie_gradient_splits).
         rng = np.random.default_rng(0)
         for h, w in ((4, 4), (5, 7), (28, 28)):
             x = jnp.asarray(rng.normal(size=(2, h, w, 3)), jnp.float32)
@@ -80,23 +80,20 @@ class TestLayers:
     @pytest.mark.skipif(jax.default_backend() != "cpu",
                         reason="fast path (and its tie semantics) is "
                                "CPU-only")
-    def test_pool_tie_gradient_matches_reduce_window(self):
-        # Tied window maxima (common post-ReLU): the CPU fast path's
-        # custom VJP one-hot routes the cotangent to the FIRST tied
-        # element in window scan order — exactly reduce_window's gradient
-        # (ADVICE r3) — so CPU and TPU training gradients agree even on
-        # tie-heavy activations.
-        rng = np.random.default_rng(7)
-        relu_sparse = jnp.maximum(jnp.asarray(
-            rng.normal(size=(2, 8, 8, 3)), jnp.float32), 0.0) * jnp.asarray(
-            rng.random((2, 8, 8, 3)) > 0.6, jnp.float32)
-        for x in (jnp.zeros((1, 2, 2, 1), jnp.float32), relu_sparse):
-            g = jax.grad(lambda x: (MaxPooling2D().apply(
-                {}, {}, x)[0] * 1.7).sum())(x)
-            g_ref = jax.grad(lambda x: (jax.lax.reduce_window(
-                x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1),
-                "VALID") * 1.7).sum())(x)
-            np.testing.assert_array_equal(np.asarray(g), np.asarray(g_ref))
+    def test_pool_tie_gradient_splits(self):
+        # DOCUMENTED divergence under ties (common post-ReLU): the CPU
+        # fast path's reduce-max VJP splits the cotangent evenly across
+        # tied maxima; reduce_window routes it to one element. Both are
+        # valid subgradients with identical expected loss. r4 implemented
+        # the exact one-hot routing three ways and each custom_vjp
+        # formulation cost 30-45% of the WHOLE CPU train step (custom_vjp
+        # is a fusion barrier mid-conv-stack), so the split behavior is
+        # the deliberate, pinned trade-off — see _nonoverlap_maxpool.
+        x = jnp.zeros((1, 2, 2, 1), jnp.float32)
+        g = jax.grad(lambda x: MaxPooling2D().apply(
+            {}, {}, x)[0].sum())(x)
+        np.testing.assert_allclose(np.asarray(g)[0, :, :, 0],
+                                   np.full((2, 2), 0.25), rtol=0, atol=0)
 
     def test_pool_overlapping_windows_still_reduce_window(self):
         # stride != pool keeps the general path; values must match the

@@ -155,35 +155,22 @@ def _conv_im2col(x, w):
     return out.reshape(b, oh, ow, cout)
 
 
-@jax.custom_vjp
 def _nonoverlap_maxpool(xw):
     """Max over the window axes of a [B, OH, WH, OW, WW, C] view.
 
-    Plain ``jnp.max`` SPLITS the cotangent across tied window maxima
-    (common post-ReLU), while reduce_window's gradient routes it to one
-    element — so the CPU fast path carries a custom VJP that one-hot
-    routes to the FIRST tied element in row-major window scan order
-    (select-and-scatter's ge-select winner), keeping CPU and TPU training
-    gradients identical (ADVICE r3)."""
+    DOCUMENTED gradient divergence on tied window maxima (common
+    post-ReLU): plain ``jnp.max``'s VJP SPLITS the cotangent across ties,
+    while TPU's reduce_window routes it to one element. Both are valid
+    subgradients; r4 implemented the exact one-hot routing three ways
+    (argmax-forward, cumsum-mask backward, static slice-loop backward)
+    and every custom_vjp formulation cost 30-45 % of the WHOLE CPU train
+    step — custom_vjp is a fusion barrier right between the conv stacks,
+    and this fast path exists purely for CPU speed (the reference's own
+    silicon). The split-tie gradient is kept and pinned in
+    tests/test_models.py::test_pool_tie_gradient_splits; expected loss is
+    unaffected (both subgradients are members of the subdifferential),
+    only per-element credit assignment under exact ties differs."""
     return jnp.max(xw, axis=(2, 4))
-
-
-def _nonoverlap_maxpool_fwd(xw):
-    b, oh, wh, ow, ww, c = xw.shape
-    t = xw.transpose(0, 1, 3, 5, 2, 4).reshape(b, oh, ow, c, wh * ww)
-    idx = jnp.argmax(t, axis=-1)  # first max in row-major window order
-    y = jnp.take_along_axis(t, idx[..., None], axis=-1)[..., 0]
-    return y, (idx, xw.shape)
-
-
-def _nonoverlap_maxpool_bwd(res, g):
-    idx, (b, oh, wh, ow, ww, c) = res
-    onehot = jax.nn.one_hot(idx, wh * ww, dtype=g.dtype)
-    gt = (g[..., None] * onehot).reshape(b, oh, ow, c, wh, ww)
-    return (gt.transpose(0, 1, 4, 2, 5, 3),)
-
-
-_nonoverlap_maxpool.defvjp(_nonoverlap_maxpool_fwd, _nonoverlap_maxpool_bwd)
 
 
 def _pool(x, window, strides, padding, init_val, op):
@@ -197,9 +184,8 @@ def _pool(x, window, strides, padding, init_val, op):
         # (both crop trailing rows/cols). CPU-only: XLA:CPU lowers
         # select_and_scatter to a ~200 ms/step scatter loop at the
         # reference's batch (pools were 2/3 of the whole step); TPU keeps
-        # reduce_window (MXU/VPU-native). Max carries a custom VJP so tied
-        # maxima route like reduce_window's gradient — see
-        # _nonoverlap_maxpool.
+        # reduce_window (MXU/VPU-native). Tie-gradient semantics: see
+        # _nonoverlap_maxpool (documented split-tie divergence).
         b, h, w, c = x.shape
         oh, ow = h // wh, w // ww
         x = x[:, :oh * wh, :ow * ww, :]
