@@ -227,15 +227,18 @@ class TestDistributeDatasetsFromFunction:
         assert (strategy.experimental_distribute_datasets_from_function
                 == strategy.distribute_datasets_from_function)
 
-    def test_uneven_replicas_per_worker_raises(self, eight_devices,
-                                               monkeypatch):
-        # ADVICE r2: flooring 8 replicas // 3 processes would silently
+    def test_uneven_replicas_per_pipeline_raises(self, eight_devices,
+                                                 monkeypatch):
+        # ADVICE r2: flooring 8 replicas // 3 pipelines would silently
         # mis-size the global batch; the wrapper must reject instead.
+        # (r4: pipelines follow the data-axis process structure —
+        # input_shard_info — not raw process_count, so the fault is
+        # simulated at that seam.)
         strategy = td.MirroredStrategy()
-        import jax
-
-        monkeypatch.setattr(jax, "process_count", lambda: 3)
-        with pytest.raises(ValueError, match="divisible by process_count"):
+        monkeypatch.setattr(type(strategy), "input_shard_info",
+                            lambda self: (3, 0))
+        with pytest.raises(ValueError,
+                           match="divisible by the input-pipeline count"):
             strategy.distribute_datasets_from_function(
                 lambda ctx: td.data.Dataset.range(8))
 

@@ -53,16 +53,31 @@ class DistributedDataset:
         self._strategy = strategy
         self._num_processes = jax.process_count()
         self._process_index = jax.process_index()
+        # Input shards follow the DATA-axis process structure, not the raw
+        # process count: pipe/model-only multi-process meshes put every
+        # process at the same data coordinate, and those processes must
+        # feed IDENTICAL replicated batches (strategy.input_shard_info).
+        info = getattr(strategy, "input_shard_info", None)
+        self._num_shards, self._shard_id = (
+            info() if info is not None
+            else (self._num_processes, self._process_index))
         effective = (policy if policy is not None
                      else dataset.auto_shard_policy)
         if effective == AutoShardPolicy.OFF:
             # Reference mode: full stream per worker, local batch as produced.
             self._local = dataset
             self._policy = AutoShardPolicy.OFF
+            if self._num_shards < self._num_processes:
+                logger.warning(
+                    "AutoShardPolicy.OFF on a mesh whose data axis does not "
+                    "span all %d processes: processes at the same data "
+                    "coordinate MUST yield identical batches (deterministic "
+                    "pipeline, seeded or no shuffle) or training silently "
+                    "diverges", self._num_processes)
         else:
-            self._policy = resolve_policy(dataset, self._num_processes, effective)
+            self._policy = resolve_policy(dataset, self._num_shards, effective)
             self._local = shard_dataset(
-                dataset, self._num_processes, self._process_index,
+                dataset, self._num_shards, self._shard_id,
                 self._policy, pre_batched=True)
         # Vectorized chain rewrite (the Grappler map_and_batch/vectorize
         # analog, data/vectorize.py): index math + batched gathers replace

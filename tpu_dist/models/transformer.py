@@ -36,6 +36,9 @@ from jax.sharding import PartitionSpec as P
 
 from tpu_dist.models.layers import Block, Dense, Layer, Residual
 from tpu_dist.ops import initializers
+# Re-exported here so model.json deserialization (models/serialize.py
+# resolves layer classes from this module) can round-trip pipelined LMs.
+from tpu_dist.parallel.pipeline_parallel import PipelinedBlocks  # noqa: F401
 
 
 @dataclasses.dataclass(frozen=True, repr=False)
@@ -331,19 +334,40 @@ def TransformerBlock(d_model: int, num_heads: int, ff_dim: int,
 def build_transformer_lm(vocab_size: int, seq_len: int, *, d_model: int = 128,
                          depth: int = 2, num_heads: int = 4,
                          ff_dim: Optional[int] = None,
-                         attention_fn: Optional[Callable] = None):
+                         attention_fn: Optional[Callable] = None,
+                         pipeline_stages: Optional[int] = None,
+                         pipeline_microbatches: int = 4):
     """A small causal (GPT-style) language model: token + position
     embeddings, ``depth`` pre-LN blocks, final LN, vocab head. Inputs are
-    int token ids [B, L]; outputs are logits [B, L, vocab]."""
+    int token ids [B, L]; outputs are logits [B, L, vocab].
+
+    ``pipeline_stages=S`` wraps the block stack in
+    :class:`tpu_dist.parallel.PipelinedBlocks` (``depth`` must divide by
+    S): under a mesh with a ``pipe`` axis of size S the stages GPipe-
+    pipeline with ``pipeline_microbatches`` microbatches; elsewhere the
+    same stacked weights run sequentially."""
     from tpu_dist.models.model import Sequential
 
     ff_dim = ff_dim or 4 * d_model
     layers = [Embedding(vocab_size, d_model),
               PositionalEmbedding(max_len=seq_len)]
-    for _ in range(depth):
-        layers.append(TransformerBlock(
-            d_model, num_heads, ff_dim, causal=True,
-            attention_fn=attention_fn))
+    mk_block = lambda: TransformerBlock(
+        d_model, num_heads, ff_dim, causal=True, attention_fn=attention_fn)
+    if pipeline_stages:
+        if depth % pipeline_stages:
+            raise ValueError(
+                f"depth {depth} not divisible by pipeline_stages "
+                f"{pipeline_stages}")
+        per_stage = depth // pipeline_stages
+        stage = (mk_block() if per_stage == 1
+                 else Block(layers=tuple(mk_block()
+                                         for _ in range(per_stage))))
+        layers.append(PipelinedBlocks(block=stage,
+                                      num_stages=pipeline_stages,
+                                      microbatches=pipeline_microbatches))
+    else:
+        for _ in range(depth):
+            layers.append(mk_block())
     layers += [LayerNormalization(), Dense(vocab_size)]
     return Sequential(layers, input_shape=(seq_len,),
                       name="transformer_lm")

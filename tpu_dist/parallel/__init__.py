@@ -28,6 +28,11 @@ from tpu_dist.parallel.tensor import (
     MODEL_AXIS,
     tensor_parallel_specs,
 )
+from tpu_dist.parallel.pipeline_parallel import (
+    PIPE_AXIS,
+    PipelinedBlocks,
+    gpipe_schedule,
+)
 from tpu_dist.parallel.strategy import (
     DefaultStrategy,
     InputContext,
@@ -60,6 +65,9 @@ __all__ = [
     "ring_attention",
     "sequence_sharding",
     "tensor_parallel_specs",
+    "PIPE_AXIS",
+    "PipelinedBlocks",
+    "gpipe_schedule",
     "DefaultStrategy",
     "InputContext",
     "MirroredStrategy",

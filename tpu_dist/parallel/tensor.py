@@ -97,6 +97,14 @@ def spec_for_param(path, leaf, *, axis_name: str = MODEL_AXIS,
     names = _dict_path_names(path)
     if len(names) < 2:
         return P()
+    # Pipeline-stacked parameters (parallel/pipeline_parallel.py): every
+    # leaf under a PipelinedBlocks layer carries a leading stage dim that
+    # shards over the 'pipe' axis; meshes without that axis degrade to
+    # replicated via prune_indivisible.
+    if any(_base(n) == "pipelinedblocks" for n in names):
+        from tpu_dist.parallel.pipeline_parallel import PIPE_AXIS
+
+        return P(PIPE_AXIS)
     layer, pname = _base(names[-2]), names[-1]
     if layer == "multiheadattention":
         if pname in _ATTN_COL_W:
@@ -152,15 +160,17 @@ def specs_like_params(tree, params_specs) -> Any:
 
 def prune_indivisible(specs, tree, mesh: Mesh):
     """Replace any spec whose sharded dimension doesn't divide evenly by
-    the mesh axis with replicated. Explicit placement (NamedSharding)
-    requires even tiling; an odd vocabulary or head count should degrade
-    to mirroring that leaf, not crash the job."""
+    the mesh axis — or that names an axis this mesh doesn't have (e.g. a
+    pipeline checkpoint restored onto a plain data mesh) — with
+    replicated. Explicit placement (NamedSharding) requires even tiling;
+    degradation must mirror the leaf, not crash the job."""
     def check(spec, leaf):
         shape = getattr(leaf, "shape", ())
         for dim, axis in enumerate(spec):
             if axis is None:
                 continue
-            if dim >= len(shape) or shape[dim] % mesh.shape[axis]:
+            if (axis not in mesh.shape or dim >= len(shape)
+                    or shape[dim] % mesh.shape[axis]):
                 return P()
         return spec
 
