@@ -91,6 +91,15 @@ class TestSequentialEquivalence:
             # review)
             y2, _ = pb.apply(params, state, jnp.ones((12, 8)))
             assert y2.shape == (12, 8)
+        # batch indivisible by the DATA axis but divisible by
+        # microbatches must also fall back (r4 review, confirmed crash)
+        s2 = td.MirroredStrategy(axis_shapes={"data": 4, "pipe": 2})
+        pb2 = PipelinedBlocks(block=_stage_block(8), num_stages=2,
+                              microbatches=2)
+        p2, st2, _ = _init(pb2, (8,))
+        with s2.scope():
+            y3, _ = pb2.apply(p2, st2, jnp.ones((6, 8)))
+            assert y3.shape == (6, 8)
 
     def test_dropout_block_gets_rng(self, eight_devices):
         # PipelinedBlocks must thread fit's rng into stages (folded per

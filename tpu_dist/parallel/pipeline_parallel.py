@@ -114,7 +114,11 @@ class PipelinedBlocks(Layer):
     ``num_stages``, apply() runs the shard_map'd pipeline; anywhere else
     (single device, CPU tests, restored onto a pipe-less topology) the
     SAME stacked parameters run as a sequential ``lax.scan`` over stages
-    — identical math, different placement.
+    — identical math, different placement, for DETERMINISTIC blocks.
+    (rng-consuming blocks like Dropout train on both paths, but draw
+    their noise differently — per stage on the fallback vs per
+    stage-and-microbatch in the pipeline — so stochastic trajectories
+    are equal in distribution, not bit-equal, across topologies.)
     """
 
     block: Layer = None
@@ -167,16 +171,19 @@ class PipelinedBlocks(Layer):
             return y
 
         mesh = self._pipe_mesh()
-        local_batch = x.shape[0]
-        if mesh is not None:
+        pipeline_ok = mesh is not None
+        if pipeline_ok:
             from tpu_dist.parallel.strategy import get_strategy
 
             data_size = mesh.shape.get(get_strategy().data_axis, 1)
             # The reshape into microbatches happens on the PER-DATA-SHARD
-            # batch inside shard_map, so divisibility must hold there.
-            local_batch = x.shape[0] // data_size if (
-                x.shape[0] % data_size == 0) else x.shape[0]
-        if mesh is None or local_batch % self.microbatches:
+            # batch inside shard_map, so BOTH divisibilities must hold:
+            # batch by the data axis, and the per-shard batch by the
+            # microbatch count — anything else falls back sequentially.
+            pipeline_ok = (x.shape[0] % data_size == 0
+                           and (x.shape[0] // data_size)
+                           % self.microbatches == 0)
+        if not pipeline_ok:
             # Sequential fallback: scan the same stacked params.
             keys = (None if rng is None
                     else jax.random.split(rng, self.num_stages))
