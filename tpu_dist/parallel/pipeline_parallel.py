@@ -34,6 +34,7 @@ in the same sense as tensor.py.
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Optional
 
 import jax
@@ -41,6 +42,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from tpu_dist.models.layers import Layer
+
+logger = logging.getLogger("tpu_dist.pipeline")
 
 #: Mesh axis name the stage dimension shards over.
 PIPE_AXIS = "pipe"
@@ -108,7 +111,10 @@ class PipelinedBlocks(Layer):
     The block must preserve its input shape (residual blocks do) and be
     stateless (no BatchNorm-style running statistics — pipeline ticks
     would race them); both are checked at init. ``microbatches`` splits
-    the batch for the GPipe schedule — the batch must divide by it.
+    each data shard for the GPipe schedule — the global batch must
+    divide by the mesh's data-axis size AND the per-shard batch by
+    ``microbatches``, or apply() falls back to the sequential path
+    (logged once).
 
     Under a strategy scope whose mesh carries a ``pipe`` axis of size
     ``num_stages``, apply() runs the shard_map'd pipeline; anywhere else
@@ -183,6 +189,16 @@ class PipelinedBlocks(Layer):
             pipeline_ok = (x.shape[0] % data_size == 0
                            and (x.shape[0] // data_size)
                            % self.microbatches == 0)
+            if not pipeline_ok and not getattr(self, "_warned", False):
+                # A silent fallback on a LIVE pipe mesh would quietly run
+                # S x slower with 1/S memory scaling lost — say so once.
+                object.__setattr__(self, "_warned", True)
+                logger.warning(
+                    "PipelinedBlocks: batch %d does not divide into "
+                    "data_axis %d x microbatches %d; running the "
+                    "SEQUENTIAL fallback despite the pipe mesh — resize "
+                    "the batch to restore pipelining",
+                    x.shape[0], data_size, self.microbatches)
         if not pipeline_ok:
             # Sequential fallback: scan the same stacked params.
             keys = (None if rng is None
