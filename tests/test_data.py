@@ -476,3 +476,76 @@ class TestRecompile:
                           optimizer=SGD(0.001))  # fine-tune at lower lr
         after = model.predict(x[:8])
         np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+class TestReplicatedDeterminismGuard:
+    """ADVICE r4: when the data axis doesn't span all processes,
+    same-data-coordinate processes must produce byte-identical streams on
+    EVERY path (OFF, autoshard, ctx-function) — a detected unseeded shuffle
+    is rejected, anything else warns."""
+
+    def test_unseeded_shuffle_rejected(self):
+        from tpu_dist.data.distribute import check_replicated_determinism
+
+        ds = _range_ds(32).shuffle(8).batch(4)
+        with pytest.raises(ValueError, match="unseeded shuffle"):
+            check_replicated_determinism(ds, 1, 2, "AutoShardPolicy.DATA")
+
+    def test_seeded_shuffle_warns_only(self, caplog):
+        import logging
+
+        from tpu_dist.data.distribute import check_replicated_determinism
+
+        ds = _range_ds(32).shuffle(8, seed=5).batch(4)
+        with caplog.at_level(logging.WARNING, logger="tpu_dist.data"):
+            check_replicated_determinism(ds, 1, 2, "AutoShardPolicy.DATA")
+        assert any("identical batches" in r.message for r in caplog.records)
+
+    def test_spanning_data_axis_is_silent(self, caplog):
+        import logging
+
+        from tpu_dist.data.distribute import check_replicated_determinism
+
+        ds = _range_ds(32).shuffle(8).batch(4)  # unseeded is FINE here
+        with caplog.at_level(logging.WARNING, logger="tpu_dist.data"):
+            check_replicated_determinism(ds, 2, 2, "AutoShardPolicy.OFF")
+        assert not caplog.records
+
+    def test_sharded_path_guarded(self, eight_devices, monkeypatch):
+        # Simulate a pipe-spanning mesh: 2 processes, 1 data shard. The
+        # AUTO/DATA branch must reject the unseeded shuffle, not just OFF.
+        import jax
+
+        from tpu_dist.parallel import MirroredStrategy
+
+        strategy = MirroredStrategy()
+        monkeypatch.setattr(type(strategy), "input_shard_info",
+                            lambda self: (1, 0))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        ds = _range_ds(32).shuffle(8).batch(4)
+        with pytest.raises(ValueError, match="unseeded shuffle"):
+            DistributedDataset(ds, strategy, policy=AutoShardPolicy.DATA)
+
+    def test_ctx_function_path_guarded(self, eight_devices, monkeypatch):
+        import jax
+
+        from tpu_dist.parallel import MirroredStrategy
+
+        strategy = MirroredStrategy()
+        monkeypatch.setattr(type(strategy), "input_shard_info",
+                            lambda self: (1, 0))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="unseeded shuffle"):
+            strategy.distribute_datasets_from_function(
+                lambda ctx: _range_ds(32).shuffle(8).batch(4))
+
+    def test_auto_seeded_non_reshuffling_rejected(self):
+        # code-review r5: shuffle(8, reshuffle_each_iteration=False) draws
+        # its fixed seed independently PER PROCESS — just as divergent as
+        # seed=None, and the spec records auto_seeded so the guard sees it.
+        from tpu_dist.data.distribute import check_replicated_determinism
+
+        ds = _range_ds(32).shuffle(
+            8, reshuffle_each_iteration=False).batch(4)
+        with pytest.raises(ValueError, match="unseeded shuffle"):
+            check_replicated_determinism(ds, 1, 2, "AutoShardPolicy.OFF")

@@ -234,3 +234,33 @@ class TestFitIntegration:
             strategy.distribute_batch(yb_host), key)[0]
         np.testing.assert_allclose(float(loss_dev), float(loss_host),
                                    rtol=1e-6)
+
+
+class TestEvalTrainIsolation:
+    """ADVICE r4: a full __iter__ pass (evaluate between epochs) must not
+    advance the seeded TRAINING permutation — fixed-seed runs must
+    reproduce regardless of eval cadence."""
+
+    def test_eval_pass_does_not_shift_training_order(self, strategy):
+        x, y = _toy(64)
+        mk = lambda: DeviceDataset(x, y, global_batch_size=8,
+                                   strategy=strategy, seed=3)
+        ref, probed = mk(), mk()
+        # Reference: 16 training batches straight through (2 epochs).
+        want = [np.asarray(ref.next_batch()[0]) for _ in range(16)]
+        # Probed: same draws with a full eval pass injected mid-epoch.
+        got = [np.asarray(probed.next_batch()[0]) for _ in range(5)]
+        for _ in probed:  # evaluate()-style full pass
+            pass
+        got += [np.asarray(probed.next_batch()[0]) for _ in range(11)]
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_eval_passes_draw_fresh_permutations(self, strategy):
+        x, y = _toy(64)
+        ds = DeviceDataset(x, y, global_batch_size=8,
+                           strategy=strategy, seed=3)
+        p1 = np.concatenate([np.asarray(b[1]) for b in ds])
+        p2 = np.concatenate([np.asarray(b[1]) for b in ds])
+        assert sorted(p1.tolist()) == sorted(p2.tolist())
+        assert not np.array_equal(p1, p2)

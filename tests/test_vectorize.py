@@ -405,3 +405,52 @@ class TestTransformCacheStability:
         assert model._trainer._device_transform is not None
         model._trainer.make_train_function(steps_per_execution=1)
         assert model._trainer._device_transform is None
+
+
+class TestAdversarialProbe:
+    def test_batch_conditional_fn_declines(self):
+        # ADVICE r4: a value-conditional batch-level fn whose first two
+        # elements sit under the threshold passed the old 2-element probe
+        # yet diverges once vectorized. The adversarial sample must catch it.
+        n = 256
+        x = np.full((n, 4, 4, 1), 10, dtype=np.uint8)
+        x[n // 2:] = 250  # elements 0-1 stay under the threshold
+        y = (np.arange(n) % 10).astype(np.int64)
+
+        def tricky(image, label):
+            img = np.asarray(image, np.float32)
+            # Batched, img.max() sees the whole batch; per-element it sees
+            # one image — identical on a homogeneous 2-element prefix.
+            return (img * 2.0 if img.max() > 200.0 else img), label
+
+        ds = Dataset.from_tensor_slices((x, y)).map(tricky).batch(32)
+        assert vectorize.try_rewrite(ds, defer_scale_to_device=False) is None
+
+    def test_label_conditional_fn_declines(self):
+        n = 128
+        x = np.zeros((n, 4, 4, 1), dtype=np.uint8)
+        y = (np.arange(n) % 10).astype(np.int64)
+
+        def classy(image, label):
+            img = np.asarray(image, np.float32)
+            # Scalar-label branch: crashes or misbehaves batched; the probe
+            # must decline, not explode.
+            if np.ndim(label) == 0 and int(label) == 7:
+                img = img + 1.0
+            return img, label
+
+        ds = Dataset.from_tensor_slices((x, y)).map(classy).batch(32)
+        assert vectorize.try_rewrite(ds, defer_scale_to_device=False) is None
+
+    def test_elementwise_fn_still_accepted(self):
+        x, y = _mnist_arrays(128)
+
+        def affine(image, label):
+            return np.asarray(image, np.float32) * 0.5 - 1.0, label
+
+        def build():
+            return Dataset.from_tensor_slices((x, y)).map(affine).batch(32)
+
+        fast = vectorize.try_rewrite(build(), defer_scale_to_device=False)
+        assert fast is not None
+        _assert_stream_equal(_batches(fast), _batches(build()))

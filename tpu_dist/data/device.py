@@ -70,6 +70,7 @@ class DeviceDataset:
         self._strategy = strategy  # None => bind to fit()'s strategy lazily
         self._dx = self._dy = None
         self._epoch = 0
+        self._eval_pass = 0  # eval has its own counter/seed stream (below)
         self._perm: Optional[np.ndarray] = None
         self._pos = 0
         self._gather_batch = None
@@ -211,8 +212,14 @@ class DeviceDataset:
         if self._gather_batch is None:
             self._gather_batch = self._build_gather(stacked=False)
         if self._shuffle:
-            rng = np.random.default_rng(self._seed + self._epoch)
-            self._epoch += 1
+            # ADVICE r4: a full pass here (evaluate() between epochs) must
+            # NOT advance the training counter — that would shift every
+            # subsequent seeded training permutation, so fixed-seed runs
+            # stop reproducing when eval cadence changes. Eval draws from a
+            # distinct seed stream (sequence-seeded rng keys never collide
+            # with the scalar `seed + epoch` train stream).
+            rng = np.random.default_rng((self._seed, 1, self._eval_pass))
+            self._eval_pass += 1
             order = rng.permutation(self._n).astype(np.int32)
         else:
             order = np.arange(self._n, dtype=np.int32)

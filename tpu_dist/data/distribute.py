@@ -35,6 +35,51 @@ from tpu_dist.data.sharding import resolve_policy, shard_dataset
 logger = logging.getLogger("tpu_dist.data")
 
 
+def _find_unseeded_shuffle(dataset) -> bool:
+    """True if the recorded combinator chain contains a shuffle whose order
+    differs per process (``seed=None`` + reshuffle => each worker draws an
+    independent RNG, pipeline.py:284-288)."""
+    node = dataset
+    while node is not None:
+        t = getattr(node, "_transform", None)
+        if (t is not None and t[0] == "shuffle"
+                and (t[1].get("seed") is None or t[1].get("auto_seeded"))):
+            # seed=None => fresh rng per pass; auto_seeded => a fixed seed
+            # drawn independently PER PROCESS at construction
+            # (pipeline.py shuffle) — both diverge across processes.
+            return True
+        node = getattr(node, "_parent", None)
+    return False
+
+
+def check_replicated_determinism(dataset, num_shards: int,
+                                 num_processes: int, path: str) -> None:
+    """Guard for meshes whose data axis does not span all processes.
+
+    On pipe/model-spanning meshes several processes sit at the same data
+    coordinate and must contribute byte-identical local batches to the same
+    global-array region — a nondeterministic pipeline silently diverges
+    training (ADVICE r4). An unseeded shuffle detected in the chain is a
+    *certain* divergence, so it is rejected; opaque generators can't be
+    proven either way, so everything else gets the warning.
+    """
+    if num_shards >= num_processes:
+        return
+    if _find_unseeded_shuffle(dataset):
+        raise ValueError(
+            f"{path}: unseeded shuffle on a mesh whose data axis does not "
+            f"span all {num_processes} processes — processes at the same "
+            "data coordinate would draw different samples for the same "
+            "global batch region and training would silently diverge. "
+            "Pass shuffle(..., seed=...) so same-coordinate processes "
+            "produce identical streams.")
+    logger.warning(
+        "%s on a mesh whose data axis does not span all %d processes: "
+        "processes at the same data coordinate MUST yield identical "
+        "batches (deterministic pipeline, seeded or no shuffle) or "
+        "training silently diverges", path, num_processes)
+
+
 class DistributedDataset:
     """Iterable of mesh-placed global batches for a strategy.
 
@@ -67,15 +112,17 @@ class DistributedDataset:
             # Reference mode: full stream per worker, local batch as produced.
             self._local = dataset
             self._policy = AutoShardPolicy.OFF
-            if self._num_shards < self._num_processes:
-                logger.warning(
-                    "AutoShardPolicy.OFF on a mesh whose data axis does not "
-                    "span all %d processes: processes at the same data "
-                    "coordinate MUST yield identical batches (deterministic "
-                    "pipeline, seeded or no shuffle) or training silently "
-                    "diverges", self._num_processes)
+            check_replicated_determinism(
+                dataset, self._num_shards, self._num_processes,
+                "AutoShardPolicy.OFF")
         else:
             self._policy = resolve_policy(dataset, self._num_shards, effective)
+            # ADVICE r4: same-data-coordinate processes get the same shard
+            # id, so the sharded stream they build must be deterministic too
+            # — the hazard is not OFF-specific.
+            check_replicated_determinism(
+                dataset, self._num_shards, self._num_processes,
+                f"AutoShardPolicy.{self._policy.name}")
             self._local = shard_dataset(
                 dataset, self._num_shards, self._shard_id,
                 self._policy, pre_batched=True)

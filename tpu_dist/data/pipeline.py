@@ -272,6 +272,9 @@ class Dataset:
         """
         if buffer_size < 1:
             raise ValueError(f"buffer_size must be >= 1, got {buffer_size}")
+        auto_seeded = seed is None  # recorded: an auto-drawn seed is still
+        # process-divergent (each process draws its own), which the
+        # replicated-determinism guard must treat as unseeded.
         if seed is None and not reshuffle_each_iteration:
             # tf.data semantics: an unseeded non-reshuffling dataset picks one
             # random seed at construction and replays that order every pass.
@@ -298,6 +301,7 @@ class Dataset:
             factory,
             transform=("shuffle",
                        {"buffer_size": buffer_size, "seed": seed,
+                        "auto_seeded": auto_seeded,
                         "reshuffle_each_iteration": reshuffle_each_iteration}))
 
     def batch(self, batch_size: int, drop_remainder: bool = False) -> "Dataset":

@@ -140,21 +140,43 @@ def _element(arrays, i: int):
     return _map_structure(lambda a: a[i], arrays)
 
 
+def _probe_indices(arrays) -> np.ndarray:
+    """Adversarial probe sample (ADVICE r4): a 2-element spot check lets a
+    value-conditional batch-level fn (``np.where(x.max() > t, ...)`` where
+    elements 0-1 stay under t) pass yet diverge once vectorized. Mirror
+    ``_detect_scale``: an evenly-spaced sweep of the source plus the first
+    occurrence of every distinct value of any small-integer leaf (a
+    class/label-conditional fn must reveal itself on some class)."""
+    leaves = _leaves(arrays)
+    n = len(leaves[0])
+    idx = np.linspace(0, n - 1, num=min(n, 32), dtype=np.int64)
+    for leaf in leaves:
+        if leaf.dtype.kind in "iu" and leaf.ndim <= 2:
+            _, first = np.unique(
+                leaf.reshape(n, -1)[:, 0], return_index=True)
+            idx = np.concatenate([idx, first[:16].astype(np.int64)])
+    return np.unique(idx)
+
+
 def _probe_vectorizable(fn: Callable, arrays) -> bool:
-    """fn(batch-of-2) must equal stack(fn(e0), fn(e1)) exactly, twice
-    (determinism). Exactness matters: elementwise math is bit-identical
-    batched or not, while anything order-sensitive (reductions, reshapes)
-    diverges and must keep the element path."""
+    """fn(batched sample) must equal stack(fn(e_i) for each element) exactly,
+    with fn(e_0) repeated for determinism. Exactness matters: elementwise
+    math is bit-identical batched or not, while anything order-sensitive
+    (reductions, reshapes) or value-conditional at batch level diverges and
+    must keep the element path. The sample is adversarial (``_probe_indices``)
+    — the rewrite's contract is that correctness never depends on it firing."""
     try:
-        e0, e1 = _element(arrays, 0), _element(arrays, 1)
+        idx = _probe_indices(arrays)
+        e0 = _element(arrays, int(idx[0]))
         f0a, f0b = _apply_fn(fn, e0), _apply_fn(fn, e0)
         if not _same(f0a, f0b):
             return False  # nondeterministic (random augmentation)
-        f1 = _apply_fn(fn, e1)
-        batched_in = _map_structure(lambda a: np.asarray(a)[0:2], arrays)
+        per_el = [f0a] + [_apply_fn(fn, _element(arrays, int(i)))
+                          for i in idx[1:]]
+        batched_in = _map_structure(lambda a: np.asarray(a)[idx], arrays)
         got = _apply_fn(fn, batched_in)
-        want_leaves = [np.stack([x, y])
-                       for x, y in zip(_leaves(f0a), _leaves(f1))]
+        want_leaves = [np.stack(cols)
+                       for cols in zip(*(_leaves(r) for r in per_el))]
         got_leaves = _leaves(got)
         return (len(got_leaves) == len(want_leaves)
                 and all(g.dtype == w.dtype and g.shape == w.shape
@@ -227,10 +249,13 @@ def _detect_scale(fns: list[Callable], arrays
                 return None
             detected = ("div", float(d))
         # The pipeline applies fn per ELEMENT; the formula above was
-        # validated against a batched application. Cross-check two single
-        # elements so a fn that silently misbehaves on batches can't
-        # validate the wrong reference.
-        for i in (0, len(probe_x) - 1):
+        # validated against a batched application. Cross-check EVERY probe
+        # element singly (ADVICE r4): a label/value-conditional fn that
+        # fires per-element but not batched (scalar-label branch) would
+        # otherwise validate the wrong reference — and the whole point of
+        # the label/ramp representatives is to be run where the branch can
+        # trigger.
+        for i in range(len(probe_x)):
             single = (probe_x[i], probe_y[i])
             for fn in fns:
                 single = _apply_fn(fn, single)

@@ -283,6 +283,17 @@ class Strategy:
         local_replicas = self.num_replicas_in_sync // num_pipelines
 
         if local_replicas > 1:
+            # ADVICE r4: when several processes share an input_pipeline_id
+            # (pipe/model-spanning meshes) the fn they each ran must have
+            # built identical streams; reject a detected unseeded shuffle,
+            # warn otherwise. Checked HERE only when the rebatch wrapper
+            # below is about to hide the combinator chain — otherwise the
+            # DistributedDataset OFF branch walks the same chain itself.
+            from tpu_dist.data.distribute import check_replicated_determinism
+
+            check_replicated_determinism(
+                dataset, num_pipelines, jax.process_count(),
+                "distribute_datasets_from_function")
             from tpu_dist.data.pipeline import _concat_structure
 
             inner = dataset  # capture BEFORE rebinding the name below
