@@ -549,3 +549,11 @@ class TestReplicatedDeterminismGuard:
             8, reshuffle_each_iteration=False).batch(4)
         with pytest.raises(ValueError, match="unseeded shuffle"):
             check_replicated_determinism(ds, 1, 2, "AutoShardPolicy.OFF")
+
+    def test_shuffle_replays_through_file_autoshard(self):
+        # code-review r5 regression: the auto_seeded record-only marker
+        # must not leak into _replay_transform's kwargs — FILE autoshard
+        # replays every recorded transform over the sharded file set.
+        ds = _range_ds(32).shuffle(8, seed=3)
+        replayed = ds._replay_transform(ds._transform)
+        assert sorted(int(v) for v in replayed) == list(range(32))

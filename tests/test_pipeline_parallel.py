@@ -238,3 +238,173 @@ class TestPipelinedLM:
             np.testing.assert_allclose(
                 np.asarray(m2.predict(xs[:2])),
                 np.asarray(model.predict(xs[:2])), rtol=2e-4, atol=2e-5)
+
+
+def _subjaxprs(value):
+    from jax.extend.core import ClosedJaxpr, Jaxpr
+
+    if isinstance(value, Jaxpr):
+        yield value
+    elif isinstance(value, ClosedJaxpr):
+        yield value.jaxpr
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            yield from _subjaxprs(v)
+
+
+def _collect_avals(jaxpr, out):
+    for eqn in jaxpr.eqns:
+        for pv in eqn.params.values():
+            for sub in _subjaxprs(pv):
+                _collect_avals(sub, out)
+        for v in eqn.outvars:
+            a = getattr(v, "aval", None)
+            if a is not None and hasattr(a, "shape"):
+                out.append(a)
+    return out
+
+
+def _float_avals_with_leading(jaxpr, dims, min_ndim=3):
+    out = []
+    for a in _collect_avals(jaxpr, []):
+        if (len(a.shape) >= min_ndim and a.shape[0] in dims
+                and jnp.issubdtype(a.dtype, jnp.floating)):
+            out.append(a)
+    return out
+
+
+class Test1F1B:
+    """1F1B schedule (pipeline_1f1b.py): same math as the sequential
+    composition, O(S) activation memory independent of M, no bubble
+    FLOPs — the r4-verdict upgrade over fit()'s GPipe path."""
+
+    V, L = 53, 8  # primes/odd sizes so M never collides with model dims
+
+    def _build(self, strategy, stages, depth, micro):
+        from tpu_dist.ops import SparseCategoricalCrossentropy
+
+        with strategy.scope():
+            model = build_transformer_lm(
+                self.V, self.L, d_model=32, depth=depth, num_heads=2,
+                pipeline_stages=stages, pipeline_microbatches=micro)
+            variables = model.init(0)
+        loss = SparseCategoricalCrossentropy(from_logits=True)
+        return model, variables, loss
+
+    def _data(self, batch):
+        rng = np.random.default_rng(3)
+        return (rng.integers(0, self.V, (batch, self.L)).astype(np.int32),
+                rng.integers(0, self.V, (batch, self.L)).astype(np.int32))
+
+    def test_matches_sequential_value_and_grad(self, eight_devices):
+        from tpu_dist.parallel import make_1f1b_train_step
+
+        strategy = td.MirroredStrategy(axis_shapes={"data": 2, "pipe": 4})
+        model, variables, loss = self._build(strategy, 4, 4, 4)
+        params, state = variables["params"], variables["state"]
+        step = make_1f1b_train_step(model, loss, strategy=strategy)
+        x, y = self._data(16)
+        lv, grads = step(params, x, y)
+
+        def ref(p):
+            logits, _ = model.apply(p, state, x, training=True)
+            return loss(logits, y)
+
+        rl, rg = jax.value_and_grad(ref)(jax.device_get(params))
+        assert abs(float(lv) - float(rl)) < 1e-5
+        fg, tg = jax.tree_util.tree_flatten(grads)
+        fr, tr = jax.tree_util.tree_flatten(rg)
+        assert tg == tr
+        for a, b in zip(fg, fr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_fewer_microbatches_than_stages(self, eight_devices):
+        # M < S exercises the capped stash (slots = min(S, M)).
+        from tpu_dist.parallel import make_1f1b_train_step
+
+        strategy = td.MirroredStrategy(axis_shapes={"data": 1, "pipe": 8})
+        model, variables, loss = self._build(strategy, 8, 8, 4)
+        params, state = variables["params"], variables["state"]
+        step = make_1f1b_train_step(model, loss, strategy=strategy)
+        x, y = self._data(8)
+        lv, grads = step(params, x, y)
+
+        def ref(p):
+            logits, _ = model.apply(p, state, x, training=True)
+            return loss(logits, y)
+
+        rl, rg = jax.value_and_grad(ref)(jax.device_get(params))
+        assert abs(float(lv) - float(rl)) < 1e-5
+        for a, b in zip(jax.tree_util.tree_leaves(grads),
+                        jax.tree_util.tree_leaves(rg)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_activation_memory_is_o_of_s_not_m(self, eight_devices):
+        # Structural pin of the memory claim: with M=16 microbatches and
+        # S=4 stages, the 1F1B program must contain NO floating-point
+        # intermediate whose leading dim scales with M (activations appear
+        # per-microbatch [mb, L, d] and in the [slots=min(S,M)] stash),
+        # while the GPipe path differentiated by jax.grad DOES stash
+        # per-tick residuals [M+S-1, ...]. M and ticks are chosen to
+        # collide with no model dimension.
+        from tpu_dist.parallel import make_1f1b_train_step
+
+        M, S = 16, 4
+        ticks_gpipe = M + S - 1  # 19
+        ticks_1f1b = 2 * (M + S - 1)  # 38
+        strategy = td.MirroredStrategy(axis_shapes={"data": 2, "pipe": 4})
+        model, variables, loss = self._build(strategy, S, 4, M)
+        params, state = variables["params"], variables["state"]
+        x, y = self._data(2 * M * 2)  # data axis 2, mb = 2
+
+        step = make_1f1b_train_step(model, loss, strategy=strategy)
+        jaxpr_1f1b = jax.make_jaxpr(lambda p: step(p, x, y))(params)
+        bad = _float_avals_with_leading(
+            jaxpr_1f1b.jaxpr, {M, ticks_gpipe, ticks_1f1b})
+        assert not bad, f"1F1B stores M-scaling activations: {bad}"
+
+        with strategy.scope():
+            def gpipe_loss(p):
+                logits, _ = model.apply(p, state, x, training=True)
+                return loss(logits, y)
+
+            jaxpr_gpipe = jax.make_jaxpr(jax.grad(gpipe_loss))(
+                jax.device_get(params))
+        m_scaling = _float_avals_with_leading(
+            jaxpr_gpipe.jaxpr, {ticks_gpipe})
+        assert m_scaling, "expected GPipe residuals stacked over ticks"
+
+    def test_trains_with_optimizer(self, eight_devices):
+        from tpu_dist.ops import SGD
+        from tpu_dist.parallel import make_1f1b_train_step
+
+        strategy = td.MirroredStrategy(axis_shapes={"data": 2, "pipe": 4})
+        model, variables, loss = self._build(strategy, 4, 4, 4)
+        params = variables["params"]
+        step = make_1f1b_train_step(model, loss, strategy=strategy)
+        opt = SGD(0.1)
+        opt_state = opt.init(params)
+        x, y = self._data(16)
+        losses = []
+        for _ in range(8):
+            lv, grads = step(params, x, y)
+            params, opt_state = opt.update(grads, opt_state, params)
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_requires_pipe_mesh_and_divisible_batch(self, eight_devices):
+        from tpu_dist.parallel import make_1f1b_train_step
+
+        strategy = td.MirroredStrategy()  # no pipe axis
+        model, variables, loss = self._build(
+            td.MirroredStrategy(axis_shapes={"data": 2, "pipe": 4}), 4, 4, 4)
+        with pytest.raises(ValueError, match="pipe"):
+            make_1f1b_train_step(model, loss, strategy=strategy)
+
+        strategy2 = td.MirroredStrategy(axis_shapes={"data": 2, "pipe": 4})
+        step = make_1f1b_train_step(model, loss, strategy=strategy2)
+        x, y = self._data(12)  # 12 % (2*4) != 0
+        with pytest.raises(ValueError, match="divide"):
+            step(variables["params"], x, y)

@@ -615,4 +615,7 @@ class Dataset:
         name, kw = transform
         if name == "with_options":
             return self.with_options(kw["options"])
+        # Drop record-only markers that are not combinator kwargs (the
+        # auto_seeded flag the replicated-determinism guard reads).
+        kw = {k: v for k, v in kw.items() if k != "auto_seeded"}
         return getattr(self, name)(**kw)

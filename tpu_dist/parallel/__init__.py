@@ -33,6 +33,10 @@ from tpu_dist.parallel.pipeline_parallel import (
     PipelinedBlocks,
     gpipe_schedule,
 )
+from tpu_dist.parallel.pipeline_1f1b import (
+    make_1f1b_train_step,
+    one_f_one_b,
+)
 from tpu_dist.parallel.strategy import (
     DefaultStrategy,
     InputContext,
@@ -68,6 +72,8 @@ __all__ = [
     "PIPE_AXIS",
     "PipelinedBlocks",
     "gpipe_schedule",
+    "make_1f1b_train_step",
+    "one_f_one_b",
     "DefaultStrategy",
     "InputContext",
     "MirroredStrategy",

@@ -20,6 +20,11 @@ the TPU way — the third parallelism family next to the ``seq`` ring
   the backward pipeline (reverse schedule, reversed ring) is DERIVED,
   not hand-written;
 * the bubble is the usual (S-1)/(M+S-1) fraction — pick M >= S;
+* this path trades memory for fit()-integration: ``jax.grad`` holds all
+  M microbatch activations before the backward pipeline starts. The
+  sibling :mod:`tpu_dist.parallel.pipeline_1f1b` hand-schedules the
+  backward (1F1B/PipeDream-flush): O(S) activation memory and no bubble
+  FLOPs, delivered as a custom-training-loop step;
 * outside a pipe mesh (single device, tests, or a checkpoint restored
   onto a different topology) the same stacked parameters run as a plain
   ``lax.scan`` over stages — placement changes, math does not, which is
@@ -144,9 +149,22 @@ class PipelinedBlocks(Layer):
                     f"pipeline stages must preserve shape; block maps "
                     f"{in_shape} -> {out_shape}")
             if _has_array_leaves(st):
+                # Permanent by design, not a missing feature: running
+                # statistics (BatchNorm) are a sequential cross-microbatch
+                # data dependency — microbatch i+1's normalizer depends on
+                # i's update — which is exactly the dependency pipelining
+                # removes. Every production pipeline framework makes the
+                # same call (GPipe and Megatron-LM pipeline LayerNorm /
+                # GroupNorm models only); batch statistics would also tie
+                # the math to the microbatch size, breaking this module's
+                # pipelined-equals-sequential contract.
                 raise ValueError(
-                    "PipelinedBlocks requires stateless blocks (running "
-                    "statistics would race across pipeline ticks)")
+                    "PipelinedBlocks requires stateless blocks: running "
+                    "statistics (BatchNorm) are a sequential dependency "
+                    "across microbatches — the very thing pipelining "
+                    "removes — and would make results depend on the "
+                    "microbatch size. Use LayerNormalization/GroupNorm "
+                    "in pipelined stacks (what GPipe/Megatron do)")
             params_list.append(p)
         stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves), *params_list)
