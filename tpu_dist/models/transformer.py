@@ -37,7 +37,9 @@ from jax.sharding import PartitionSpec as P
 from tpu_dist.models.layers import Block, Dense, Layer, Residual
 from tpu_dist.ops import initializers
 # Re-exported here so model.json deserialization (models/serialize.py
-# resolves layer classes from this module) can round-trip pipelined LMs.
+# resolves layer classes from this module) can round-trip pipelined and
+# mixture-of-experts LMs.
+from tpu_dist.parallel.expert import MixtureOfExperts  # noqa: F401
 from tpu_dist.parallel.pipeline_parallel import PipelinedBlocks  # noqa: F401
 
 
@@ -306,12 +308,15 @@ def TransformerBlock(d_model: int, num_heads: int, ff_dim: int,
                      key_dim: Optional[int] = None, causal: bool = False,
                      activation: str = "gelu",
                      attention_fn: Optional[Callable] = None,
-                     epsilon: float = 1e-5) -> Block:
+                     epsilon: float = 1e-5,
+                     moe=None) -> Block:
     """Pre-LN transformer block: x + MHA(LN(x)), then x + MLP(LN(x)) —
     built from the existing Residual container (identity shortcut), so
     params nest exactly like the ResNet blocks. ``d_model`` is the residual
     stream width (the MLP projects ff_dim back to it); ``key_dim`` defaults
-    to d_model / num_heads."""
+    to d_model / num_heads. ``moe`` (a
+    :class:`tpu_dist.parallel.MixtureOfExperts`) replaces the dense MLP
+    with the expert-parallel FFN — the Switch-transformer block shape."""
     if key_dim is None:
         if d_model % num_heads:
             raise ValueError(
@@ -323,10 +328,10 @@ def TransformerBlock(d_model: int, num_heads: int, ff_dim: int,
               MultiHeadAttention(num_heads=num_heads, key_dim=key_dim,
                                  causal=causal, attention_fn=attention_fn)),
         shortcut=(), activation=None)
+    ffn = ((moe,) if moe is not None
+           else (Dense(ff_dim, activation=activation), Dense(d_model)))
     mlp = Residual(
-        main=(LayerNormalization(epsilon=epsilon),
-              Dense(ff_dim, activation=activation),
-              Dense(d_model)),
+        main=(LayerNormalization(epsilon=epsilon), *ffn),
         shortcut=(), activation=None)
     return Block(layers=(attn, mlp))
 
@@ -336,7 +341,12 @@ def build_transformer_lm(vocab_size: int, seq_len: int, *, d_model: int = 128,
                          ff_dim: Optional[int] = None,
                          attention_fn: Optional[Callable] = None,
                          pipeline_stages: Optional[int] = None,
-                         pipeline_microbatches: int = 4):
+                         pipeline_microbatches: int = 4,
+                         moe_experts: Optional[int] = None,
+                         moe_top_k: int = 2,
+                         moe_capacity_factor: float = 1.25,
+                         moe_groups: Optional[int] = None,
+                         moe_every: int = 1):
     """A small causal (GPT-style) language model: token + position
     embeddings, ``depth`` pre-LN blocks, final LN, vocab head. Inputs are
     int token ids [B, L]; outputs are logits [B, L, vocab].
@@ -345,14 +355,35 @@ def build_transformer_lm(vocab_size: int, seq_len: int, *, d_model: int = 128,
     :class:`tpu_dist.parallel.PipelinedBlocks` (``depth`` must divide by
     S): under a mesh with a ``pipe`` axis of size S the stages GPipe-
     pipeline with ``pipeline_microbatches`` microbatches; elsewhere the
-    same stacked weights run sequentially."""
+    same stacked weights run sequentially.
+
+    ``moe_experts=E`` makes every ``moe_every``-th block a
+    Switch-transformer block (:class:`tpu_dist.parallel.MixtureOfExperts`
+    replaces the dense MLP; ``ff_dim`` sizes each expert): under a mesh
+    with an ``expert`` axis the experts shard and tokens all_to_all;
+    elsewhere the same stacked experts run locally. MoE and
+    ``pipeline_stages`` are mutually exclusive (the aux loss is state the
+    pipeline cannot thread)."""
     from tpu_dist.models.model import Sequential
 
     ff_dim = ff_dim or 4 * d_model
+    if moe_experts and pipeline_stages:
+        raise ValueError("moe_experts and pipeline_stages are mutually "
+                         "exclusive (see docstring)")
     layers = [Embedding(vocab_size, d_model),
               PositionalEmbedding(max_len=seq_len)]
-    mk_block = lambda: TransformerBlock(
-        d_model, num_heads, ff_dim, causal=True, attention_fn=attention_fn)
+
+    def mk_moe():
+        return MixtureOfExperts(
+            num_experts=moe_experts, ff_dim=ff_dim, top_k=moe_top_k,
+            capacity_factor=moe_capacity_factor, groups=moe_groups)
+
+    def mk_block(i: int = 0):
+        moe = (mk_moe() if moe_experts and i % max(moe_every, 1) == 0
+               else None)
+        return TransformerBlock(
+            d_model, num_heads, ff_dim, causal=True,
+            attention_fn=attention_fn, moe=moe)
     if pipeline_stages:
         if depth % pipeline_stages:
             raise ValueError(
@@ -366,8 +397,8 @@ def build_transformer_lm(vocab_size: int, seq_len: int, *, d_model: int = 128,
                                       num_stages=pipeline_stages,
                                       microbatches=pipeline_microbatches))
     else:
-        for _ in range(depth):
-            layers.append(mk_block())
+        for i in range(depth):
+            layers.append(mk_block(i))
     layers += [LayerNormalization(), Dense(vocab_size)]
     return Sequential(layers, input_shape=(seq_len,),
                       name="transformer_lm")

@@ -37,6 +37,10 @@ from tpu_dist.parallel.pipeline_1f1b import (
     make_1f1b_train_step,
     one_f_one_b,
 )
+from tpu_dist.parallel.expert import (
+    EXPERT_AXIS,
+    MixtureOfExperts,
+)
 from tpu_dist.parallel.strategy import (
     DefaultStrategy,
     InputContext,
@@ -74,6 +78,8 @@ __all__ = [
     "gpipe_schedule",
     "make_1f1b_train_step",
     "one_f_one_b",
+    "EXPERT_AXIS",
+    "MixtureOfExperts",
     "DefaultStrategy",
     "InputContext",
     "MirroredStrategy",

@@ -151,6 +151,15 @@ class Strategy:
 
         return self._mesh.shape.get(PIPE_AXIS, 1) > 1
 
+    @property
+    def expert_parallel(self) -> bool:
+        """True when the mesh carries an ``'expert'`` axis of size > 1 —
+        MixtureOfExperts stacks then shard experts-per-device
+        (parallel/expert.py)."""
+        from tpu_dist.parallel.expert import EXPERT_AXIS
+
+        return self._mesh.shape.get(EXPERT_AXIS, 1) > 1
+
     def param_spec_tree(self, params):
         """PartitionSpec tree for a params tree: tensor-parallel /
         pipeline rules when the mesh has a ``'model'`` / ``'pipe'`` axis,
@@ -159,7 +168,8 @@ class Strategy:
         from jax.sharding import PartitionSpec
         from tpu_dist.parallel import tensor
 
-        if self.model_parallel or self.pipeline_parallel:
+        if (self.model_parallel or self.pipeline_parallel
+                or self.expert_parallel):
             return tensor.tensor_parallel_specs(params)
         import jax
 

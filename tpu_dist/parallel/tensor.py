@@ -106,6 +106,12 @@ def spec_for_param(path, leaf, *, axis_name: str = MODEL_AXIS,
 
         return P(PIPE_AXIS)
     layer, pname = _base(names[-2]), names[-1]
+    if layer == "mixtureofexperts":
+        # Expert-stacked FFN leaves carry a leading E dim that shards over
+        # the 'expert' axis (parallel/expert.py); the router replicates.
+        from tpu_dist.parallel.expert import EXPERT_AXIS
+
+        return P() if pname == "router" else P(EXPERT_AXIS)
     if layer == "multiheadattention":
         if pname in _ATTN_COL_W:
             return P(None, axis_name)

@@ -49,6 +49,21 @@ from tpu_dist.utils.progbar import ProgressBar
 logger = logging.getLogger("tpu_dist.trainer")
 
 
+def _aux_loss_total(state_tree):
+    """Sum of every state leaf keyed 'aux_loss' (model-internal auxiliary
+    losses — Keras add_loss analog; see parallel/expert.py). 0.0 when the
+    model declares none, so pure models trace identically."""
+    import jax.numpy as jnp
+
+    total = 0.0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state_tree)[0]:
+        last = path[-1] if path else None
+        key = getattr(last, "key", None)
+        if key == "aux_loss":
+            total = total + jnp.asarray(leaf, jnp.float32)
+    return total
+
+
 def jnp_stack_keys(root_key, base: int, k: int):
     """[k, keydim] stacked fold_in keys for a scanned multi-step execution."""
     import jax.numpy as jnp
@@ -235,6 +250,12 @@ class Trainer:
             def loss_fn(p):
                 logits, new_state = model.apply(p, state, x, training=True,
                                                 rng=rng)
+                # Model-internal auxiliary losses (the Keras add_loss
+                # analog): any state leaf named 'aux_loss' — e.g. the MoE
+                # load-balance term (parallel/expert.py, pre-scaled by the
+                # layer) — joins the training objective. Metrics and
+                # evaluate() keep reporting the pure task loss.
+                aux = _aux_loss_total(new_state)
                 if class_weight is not None:
                     # Keras class_weight semantics: scale each sample's loss
                     # contribution by its class's weight (default 1.0)
@@ -254,8 +275,8 @@ class Trainer:
                     w = jnp.ones_like(per)
                     for c, wt in class_weight.items():
                         w = jnp.where(y == c, jnp.float32(wt), w)
-                    return (per * w).mean(), (logits, new_state)
-                return loss_obj(logits, y), (logits, new_state)
+                    return (per * w).mean() + aux, (logits, new_state)
+                return loss_obj(logits, y) + aux, (logits, new_state)
 
             (loss, (logits, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
