@@ -209,7 +209,6 @@ class MixtureOfExperts(Layer):
         n_tokens = math.prod(int(s) for s in lead)
         mesh = self._expert_mesh()
         if mesh is not None:
-            from tpu_dist.parallel import mesh as mesh_lib
             from tpu_dist.parallel.strategy import get_strategy
 
             strategy = get_strategy()

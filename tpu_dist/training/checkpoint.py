@@ -29,6 +29,16 @@ logger = logging.getLogger("tpu_dist.checkpoint")
 _POINTER = "checkpoint"
 _MANIFEST = "manifest.json"
 _ARRAYS = "arrays.npz"
+_FORMAT_V1 = "tpu_dist.checkpoint.v1"
+_FORMAT_V2 = "tpu_dist.checkpoint.v2-sharded"
+
+
+def _shard_arrays(process: int) -> str:
+    return f"arrays-shard-{process}.npz"
+
+
+def _shard_index(process: int) -> str:
+    return f"shards-{process}.json"
 
 
 def _to_host(leaf) -> np.ndarray:
@@ -100,12 +110,25 @@ def _step_dir(directory: pathlib.Path, step: int) -> pathlib.Path:
 
 
 def save(directory: str | os.PathLike, model_or_variables, *, step: int,
-         max_to_keep: Optional[int] = None) -> Optional[str]:
-    """Write checkpoint ``step``; returns its path (None on non-chief).
+         max_to_keep: Optional[int] = None,
+         sharded: bool = False) -> Optional[str]:
+    """Write checkpoint ``step``; returns its path (None on non-chief
+    unless ``sharded``).
 
     Accepts a compiled Model (saves its live training variables) or a raw
     variables pytree. Only the chief writes (README.md:51); all processes
     rendezvous afterwards so no peer races ahead of a half-written checkpoint.
+
+    ``sharded=True`` writes the v2 layout instead: EVERY process writes its
+    own ``arrays-shard-p.npz`` holding only its addressable shards of
+    non-replicated leaves (O(model/P) host memory and P-way parallel write
+    bandwidth — the matching story for TP/PP/EP-sharded models, where the
+    chief-writes path would allgather O(model) through one host), the chief
+    writes replicated leaves + the manifest, and two barriers bracket a
+    chief-created staging directory so the rename publish stays atomic.
+    Requires a FILESYSTEM SHARED by all processes (the standard sharded-
+    checkpoint contract); restore re-places onto whatever mesh is current,
+    so cross-topology moves work exactly like v1.
     """
     variables = getattr(model_or_variables, "variables", model_or_variables)
     if variables is None:
@@ -114,6 +137,9 @@ def save(directory: str | os.PathLike, model_or_variables, *, step: int,
     saveable = {k: variables[k] for k in ("params", "state", "opt")
                 if k in variables}
     directory = pathlib.Path(directory)
+    if sharded:
+        return _save_sharded(directory, saveable, step=step,
+                             max_to_keep=max_to_keep)
     path = None
     # Tensor-parallel leaves require a cross-process allgather (a collective),
     # so non-chief processes must JOIN each gather — but only the gathers:
@@ -136,7 +162,7 @@ def save(directory: str | os.PathLike, model_or_variables, *, step: int,
             (tmp_path / _MANIFEST).write_text(json.dumps({
                 "step": step,
                 "keys": sorted(flat),
-                "format": "tpu_dist.checkpoint.v1",
+                "format": _FORMAT_V1,
             }))
             if target.exists():
                 import shutil
@@ -150,6 +176,157 @@ def save(directory: str | os.PathLike, model_or_variables, *, step: int,
             _gc(directory, max_to_keep)
     bootstrap.barrier(f"checkpoint_save_{step}")
     return path
+
+
+def _is_replicated(leaf) -> bool:
+    """Leaves the chief owns in the v2 layout: everything that is not a
+    multi-device-sharded jax.Array (host numpy, scalars, replicated)."""
+    if not isinstance(leaf, jax.Array):
+        return True
+    return leaf.is_fully_replicated
+
+
+def _save_sharded(directory: pathlib.Path, saveable, *, step: int,
+                  max_to_keep: Optional[int]) -> str:
+    proc = bootstrap.process_index()
+    stage = directory / f".stage-{step}"
+    target = _step_dir(directory, step)
+    if bootstrap.is_chief():
+        directory.mkdir(parents=True, exist_ok=True)
+        if stage.exists():
+            import shutil
+
+            shutil.rmtree(stage)
+        stage.mkdir()
+    bootstrap.barrier(f"checkpoint_stage_{step}")
+
+    # Every process: its addressable replica-0 shards of sharded leaves.
+    # replica_id==0 picks exactly one owner per distinct shard index, so
+    # leaves replicated over SOME axes (e.g. P('pipe') on a data x pipe
+    # mesh) are written once, and the union over processes tiles the
+    # global array exactly (asserted at assembly).
+    local_arrays: dict[str, np.ndarray] = {}
+    index: dict[str, list] = {}
+    chief_arrays: dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(saveable)[0]:
+        key = jax.tree_util.keystr(path)
+        if _is_replicated(leaf):
+            if bootstrap.is_chief():
+                chief_arrays[key] = np.asarray(leaf)
+            continue
+        entries = []
+        for j, shard in enumerate(leaf.addressable_shards):
+            if shard.replica_id != 0:
+                continue
+            name = f"{key}//{j}"
+            local_arrays[name] = np.asarray(shard.data)
+            entries.append({
+                "name": name,
+                "slices": [[s.start or 0,
+                            s.stop if s.stop is not None else dim]
+                           for s, dim in zip(shard.index, leaf.shape)],
+            })
+        if entries:
+            index[key] = entries
+    np.savez(stage / _shard_arrays(proc), **local_arrays)
+    (stage / _shard_index(proc)).write_text(json.dumps(index))
+    if bootstrap.is_chief():
+        np.savez(stage / _ARRAYS, **chief_arrays)
+        meta = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(saveable)[0]:
+            key = jax.tree_util.keystr(path)
+            dtype = (leaf.dtype if hasattr(leaf, "dtype")
+                     else np.asarray(leaf).dtype)
+            meta[key] = {
+                "shape": list(np.shape(leaf)),
+                "dtype": str(dtype),
+                "sharded": not _is_replicated(leaf),
+            }
+        (stage / _MANIFEST).write_text(json.dumps({
+            "step": step,
+            "format": _FORMAT_V2,
+            "process_count": jax.process_count(),
+            "leaves": meta,
+        }))
+    bootstrap.barrier(f"checkpoint_written_{step}")
+    if bootstrap.is_chief():
+        if target.exists():
+            import shutil
+
+            shutil.rmtree(target)
+        os.replace(stage, target)
+        (directory / _POINTER).write_text(str(step))
+        logger.info("sharded checkpoint step %d written to %s (%d writers)",
+                    step, target, jax.process_count())
+        if max_to_keep is not None:
+            _gc(directory, max_to_keep)
+    bootstrap.barrier(f"checkpoint_save_{step}")
+    return str(target)
+
+
+def _manifest(target: pathlib.Path) -> dict:
+    mf = target / _MANIFEST
+    if mf.is_file():
+        try:
+            return json.loads(mf.read_text())
+        except ValueError:
+            pass
+    return {}
+
+
+def _iter_sharded_leaves(target: pathlib.Path):
+    """Yield ``(key, assemble)`` for every leaf of a v2 checkpoint —
+    ``assemble()`` materializes that ONE leaf's global host array.
+    ``restore`` currently materializes all leaves (its contract returns a
+    host pytree); the per-leaf shape exists so a streaming restore —
+    assemble one leaf, ``device_put`` it, drop the host copy — can be
+    built on it without touching the file format."""
+    manifest = _manifest(target)
+    indices: dict[str, list] = {}
+    by_file: dict[str, dict] = {}
+    for idx_file in sorted(target.glob("shards-*.json")):
+        arr_file = target / idx_file.name.replace(
+            "shards-", "arrays-shard-").replace(".json", ".npz")
+        listing = json.loads(idx_file.read_text())
+        for key, entries in listing.items():
+            for e in entries:
+                e["file"] = str(arr_file)
+            indices.setdefault(key, []).extend(entries)
+    chief = target / _ARRAYS
+
+    def load_from(fname, name):
+        z = by_file.get(fname)
+        if z is None:
+            z = by_file[fname] = np.load(fname)
+        return z[name]
+
+    for key, meta in manifest["leaves"].items():
+        if not meta["sharded"]:
+            yield key, (lambda k=key: load_from(str(chief), k))
+            continue
+
+        def assemble(k=key, m=meta):
+            entries = indices.get(k)
+            if not entries:
+                raise FileNotFoundError(
+                    f"sharded checkpoint {target} has no shards for {k!r} "
+                    "— were all processes' shard files on this "
+                    "filesystem? (v2 checkpoints require a shared FS)")
+            out = np.zeros(tuple(m["shape"]), np.dtype(m["dtype"]))
+            filled = 0
+            for e in entries:
+                data = load_from(e["file"], e["name"])
+                sl = tuple(slice(a, b) for a, b in e["slices"])
+                out[sl] = data
+                filled += data.size
+            if filled != out.size:
+                raise ValueError(
+                    f"sharded checkpoint {target}: shards for {k!r} "
+                    f"cover {filled} of {out.size} elements — missing "
+                    "shard files (v2 checkpoints require a shared FS)")
+            return out
+
+        yield key, assemble
 
 
 def _gc(directory: pathlib.Path, max_to_keep: int) -> None:
@@ -212,6 +389,27 @@ def restore(directory: str | os.PathLike, template: Any, *,
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {directory}")
     target = _step_dir(directory, step)
+    # The FORMAT branch must be uniform cluster-wide: the v2 path returns
+    # without broadcasting, so a peer whose (eventually-consistent) FS view
+    # is stale taking the v1 branch would hang in broadcast_from_chief
+    # waiting for a collective the chief never joins. Chief decides,
+    # everyone follows; a stale peer on the v2 path then fails with the
+    # clear missing-shards error instead of deadlocking.
+    is_v2 = _manifest(target).get("format") == _FORMAT_V2
+    if jax.process_count() > 1:
+        from tpu_dist.parallel.collectives import broadcast_from_chief
+
+        is_v2 = bool(int(broadcast_from_chief(np.int64(int(is_v2)))))
+    if is_v2:
+        # v2 (sharded) lives on a shared FS by contract: every process
+        # assembles directly from the shard files — no broadcast needed.
+        arrays = {k: assemble()
+                  for k, assemble in _iter_sharded_leaves(target)}
+        host_template = jax.tree_util.tree_map(_placeholder, template)
+        restored = _unflatten_into(host_template, arrays)
+        logger.info("restored sharded checkpoint step %d from %s",
+                    step, target)
+        return restored, step
     # The template's VALUES are never read — the chief overwrites every leaf
     # from the npz and peers receive the broadcast — so sharded leaves (a TP
     # job's live variables) become zero placeholders of their GLOBAL shape
