@@ -1,0 +1,110 @@
+"""Host->device link probe: synchronous vs pipelined transfer bandwidth.
+
+Settles the r4 contradiction (VERDICT r4 #3): the recorded
+``h2d_floor_note`` claimed "~18 MB/s tunnel bandwidth => uint8 MNIST caps
+at ~23k img/s/core" while the same record measured 41.3k img/s/core
+(~32 MB/s of pixel traffic). The r4 probe measured SERIALIZED transfers —
+each device_put's payload acknowledged (forced reduction + scalar fetch)
+before the next began, so every transfer paid the tunnel's base latency.
+The training pipeline overlaps: prefetched batches stream while the chip
+computes, amortizing the latency across in-flight transfers. This probe
+measures both shapes at several payload sizes and in-flight depths.
+
+Timing rule (memory: tunnel timing artifacts): every window ends with a
+``jax.device_get`` of a scalar that data-depends on EVERY transferred
+buffer — block_until_ready alone has returned early through this tunnel.
+Run on the target host:  python benchmarks/h2d_probe.py
+Writes benchmarks/h2d_probe_r5.json.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    out = {"platform": dev.platform, "device": str(dev)}
+
+    # Base RTT: min-of-5 scalar fetch.
+    s = jax.device_put(np.float32(1.0), dev)
+    jax.block_until_ready(s)
+    rtts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.device_get(s)
+        rtts.append(time.perf_counter() - t0)
+    rtt = min(rtts)
+    out["scalar_fetch_rtt_ms"] = round(rtt * 1e3, 2)
+
+    reduce_all = jax.jit(lambda *bufs: sum(b.sum(dtype=jnp.float32)
+                                           for b in bufs))
+
+    def payload(kind: str, i: int, n: int) -> np.ndarray:
+        if kind == "random":
+            return np.random.default_rng(i).integers(
+                0, 255, size=n, dtype=np.uint8)
+        if kind == "zeros":
+            return np.zeros(n, np.uint8)
+        # mnist-like: the synthetic image stream the benches ship.
+        from tpu_dist.data.sources import load_arrays
+
+        img, _ = load_arrays("mnist", "train", synthetic_size=8192)
+        return np.resize(img.reshape(-1), n)
+
+    def measure(kind: str, payload_mb: float, depth: int,
+                reps: int = 4) -> float:
+        """MB/s moving `depth` in-flight buffers of `payload_mb` each,
+        repeated; returns the best window (ambient-load floor)."""
+        n = int(payload_mb * 1e6)
+        host = [payload(kind, i, n) for i in range(depth)]
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            bufs = [jax.device_put(h, dev) for h in host]
+            got = jax.device_get(reduce_all(*bufs))
+            dt = time.perf_counter() - t0 - rtt
+            assert np.isfinite(got)
+            best = max(best, depth * n / dt / 1e6)
+        return round(best, 2)
+
+    # Synchronous shape: one buffer at a time, acknowledged each time
+    # (depth=1) — the r4 probe's measurement.
+    out["sync_mb_s"] = {f"{mb}MB": measure("random", mb, 1)
+                        for mb in (0.25, 1, 4)}
+    # Pipelined shape: `depth` transfers in flight before the reduction —
+    # the training pipeline's shape (prefetch + async dispatch).
+    out["pipelined_mb_s"] = {
+        f"{mb}MB x{d}": measure("random", mb, d)
+        for mb in (0.25, 1) for d in (4, 8, 16)}
+    # Payload-dependence: the tunnel moves compressible streams faster
+    # (zeros ~1.6-2x random; the benches' synthetic MNIST sits between),
+    # so image-stream ceilings exceed random-byte probes.
+    out["pipelined_by_payload_mb_s"] = {
+        kind: measure(kind, 1, 8) for kind in ("random", "zeros", "mnist")}
+    out["note"] = (
+        "sync = each payload acknowledged before the next (pays full "
+        "base latency per transfer); pipelined = depth payloads in "
+        "flight, one data-dependent scalar fetch at the end. The "
+        "hostpipe e2e bench runs the pipelined shape (prefetch 2 + "
+        "async dispatch) on a compressible image stream, so ITS ceiling "
+        "is the pipelined mnist-payload number — and ALL of these swing "
+        "2-3x with ambient tunnel load (12-42 MB/s observed across "
+        "minutes); treat any single sample as a floor, not the link "
+        "rate.")
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "h2d_probe_r5.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

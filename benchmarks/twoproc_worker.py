@@ -22,6 +22,20 @@ import time
 
 
 def main() -> int:
+    # r5 busy-poll mitigation experiment (VERDICT r4 #6): gloo's collective
+    # wait SPINS, stealing the shared core from the computing peer on this
+    # 1-core host. SCHED_BATCH lengthens timeslices (fewer mid-compute
+    # preemptions by the spinning sibling); SCHED_IDLE would demote the
+    # spin only if the kernel could tell it from compute (it can't — same
+    # thread does both). The parent runs both settings and records them.
+    sched = os.environ.get("TWOPROC_SCHED")
+    if sched:
+        try:
+            policy = {"batch": os.SCHED_BATCH, "idle": os.SCHED_IDLE}[sched]
+            os.sched_setscheduler(0, policy, os.sched_param(0))
+        except (OSError, KeyError, AttributeError) as e:
+            print(f"TWOPROC_SCHED={sched} unavailable: {e}",
+                  file=sys.stderr)
     warmup_steps = int(os.environ.get("TWOPROC_WARMUP_STEPS", "16"))
     timed_steps = int(os.environ.get("TWOPROC_TIMED_STEPS", "60"))
     windows = int(os.environ.get("TWOPROC_WINDOWS", "2"))
