@@ -1,0 +1,132 @@
+"""One timed configuration on a virtual CPU mesh — scaling_r5's child.
+
+Builds a transformer LM (or the reference CNN) under a
+``MirroredStrategy(axis_shapes=...)`` mesh, jits ``value_and_grad`` of
+the training loss (no optimizer update — the bubble/overhead comparisons
+measure the fwd+bwd schedule itself), and times it with one execution in
+flight at a time (the XLA:CPU multi-device rendezvous-starvation rule —
+see tpu_dist/training/trainer.py _bounded_dispatch).
+
+Schedules:
+* ``none``  — plain DP/TP/sequential model (GSPMD partitions the jit).
+* ``gpipe`` — PipelinedBlocks fit-path schedule (jax.grad through the
+  forward scan; bubble ticks compute on don't-care data).
+* ``1f1b``  — the hand-scheduled pipeline_1f1b step (bubble ticks take
+  the no-op switch branch; backward recomputes the stage forward).
+
+Prints one JSON line: {"step_ms": ..., "repeats_ms": [...], ...}.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="transformer_lm",
+                   choices=("transformer_lm", "mnist_cnn"))
+    p.add_argument("--axes", required=True,
+                   help="comma list, e.g. data=2,model=4")
+    p.add_argument("--batch", type=int, required=True)
+    p.add_argument("--seq", type=int, default=64)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--depth", type=int, default=4)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--schedule", default="none",
+                   choices=("none", "gpipe", "1f1b"))
+    p.add_argument("--micro", type=int, default=4)
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--repeats", type=int, default=2)
+    args = p.parse_args()
+
+    axes = {}
+    for part in args.axes.split(","):
+        k, v = part.split("=")
+        axes[k] = int(v)
+
+    import jax
+    import numpy as np
+
+    import tpu_dist as td
+    from tpu_dist.ops import SparseCategoricalCrossentropy
+
+    strategy = td.MirroredStrategy(axis_shapes=axes)
+    loss = SparseCategoricalCrossentropy(from_logits=True)
+    rng = np.random.default_rng(0)
+
+    if args.config == "transformer_lm":
+        from tpu_dist.models.transformer import build_transformer_lm
+
+        stages = axes.get("pipe", 0)
+        kw = {}
+        if args.schedule in ("gpipe", "1f1b"):
+            assert stages >= 2, "pipe schedules need a pipe axis"
+            kw = dict(pipeline_stages=stages,
+                      pipeline_microbatches=args.micro)
+        with strategy.scope():
+            model = build_transformer_lm(
+                args.vocab, args.seq, d_model=args.d_model,
+                depth=args.depth, num_heads=4, **kw)
+            variables = model.init(0)
+        x = rng.integers(0, args.vocab,
+                         (args.batch, args.seq)).astype(np.int32)
+        y = rng.integers(0, args.vocab,
+                         (args.batch, args.seq)).astype(np.int32)
+    else:
+        from tpu_dist.models.cnn import build_cnn_model
+
+        with strategy.scope():
+            model = build_cnn_model()
+            variables = model.init(0)
+        x = rng.normal(size=(args.batch, 28, 28, 1)).astype(np.float32)
+        y = rng.integers(0, 10, size=(args.batch,)).astype(np.int64)
+
+    params, state = variables["params"], variables["state"]
+
+    if args.schedule == "1f1b":
+        from tpu_dist.parallel import make_1f1b_train_step
+
+        step_fn = make_1f1b_train_step(model, loss, strategy=strategy)
+
+        def run_once():
+            lv, grads = step_fn(params, x, y)
+            jax.block_until_ready(lv)
+    else:
+        def loss_fn(pr):
+            with strategy.scope():
+                logits, _ = model.apply(pr, state, x, training=True)
+            return loss(logits, y)
+
+        # The mesh comes from the strategy scope captured at trace time;
+        # re-entering the scope inside the traced fn keeps PipelinedBlocks
+        # dispatching onto the pipe axis.
+        vg = jax.jit(jax.value_and_grad(loss_fn))
+
+        def run_once():
+            lv, grads = vg(params)
+            jax.block_until_ready(lv)
+
+    for _ in range(args.warmup):
+        run_once()
+    repeats_ms = []
+    for _ in range(args.repeats):
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            run_once()
+        repeats_ms.append(
+            (time.perf_counter() - t0) / args.steps * 1e3)
+    print(json.dumps({
+        "config": args.config, "axes": axes, "schedule": args.schedule,
+        "micro": args.micro, "batch": args.batch, "seq": args.seq,
+        "d_model": args.d_model, "depth": args.depth,
+        "step_ms": round(min(repeats_ms), 3),
+        "repeats_ms": [round(v, 3) for v in repeats_ms],
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""Per-component MFU audit of the transformer-LM bf16 train step.
+
+VERDICT r4 #7: do for the LM what resnet50_audit did for ResNet —
+account for where the step's time goes (flash attention window, matmuls,
+layernorm, vocab-head + cross-entropy) against each component's analytic
+FLOPs, then either act on the biggest sink or record the audited
+ceiling. Shapes are the bench headline's (bench.py TRANSFORMER_LM:
+vocab 8192, d_model 512, depth 4, heads 8; seq 512, batch 64,
+mixed_bfloat16).
+
+Method: each component is jitted as value_and_grad of a scalar-reduced
+output at the exact shapes it sees inside the step, timed on the chip
+with the tunnel-safe pattern (device_get of a data-dependent scalar,
+min-of-reps; bench.py r4 rules). Component MFU = analytic model FLOPs
+(fwd + 2x bwd) / time / peak. The full step's measured time is then set
+against the sum of its parts — the residual is XLA's fusion win (or
+loss) plus optimizer/dispatch.
+
+Writes benchmarks/lm_audit_r5.json.  Run on the TPU host:
+    python benchmarks/lm_audit.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: v5e bf16 peak (datasheet-order figure, same constant bench.py uses).
+BF16_PEAK_TFLOPS = 394.0
+
+B, L, D, H, FF, V, DEPTH = 64, 512, 512, 8, 2048, 8192, 4
+N = B * L  # tokens per step
+
+
+def timed(fn, args, reps=8):
+    import jax
+
+    out = fn(*args)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def component_rows():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    bf16 = jnp.bfloat16
+    rows = {}
+
+    def add(name, fn, args, model_flops):
+        ms = timed(fn, args)
+        rows[name] = {
+            "ms": round(ms, 3),
+            "model_gflops": round(model_flops / 1e9, 1),
+            "mfu_pct": round(
+                model_flops / (ms / 1e3) / (BF16_PEAK_TFLOPS * 1e12)
+                * 100, 1),
+        }
+        print(name, rows[name], file=sys.stderr)
+
+    # 1) flash attention at the LM's per-layer shape (causal).
+    from tpu_dist.ops import flash_attention as fa
+
+    q = jnp.asarray(rng.normal(size=(B, H, L, D // H)), bf16)
+    k = jnp.asarray(rng.normal(size=(B, H, L, D // H)), bf16)
+    v = jnp.asarray(rng.normal(size=(B, H, L, D // H)), bf16)
+    scale = 1.0 / (D // H) ** 0.5
+
+    flash_vg = jax.jit(jax.grad(
+        lambda a, b, c: fa.flash_attention(
+            a, b, c, causal=True, scale=scale).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2)))
+    add("flash_attention_per_layer", flash_vg, (q, k, v),
+        fa.analytic_train_flops(B, H, L, D // H, causal=True))
+
+    # 2) MLP (d -> ff -> d, gelu) fwd+bwd.
+    x = jnp.asarray(rng.normal(size=(N, D)), bf16)
+    w1 = jnp.asarray(rng.normal(size=(D, FF)) * 0.02, bf16)
+    w2 = jnp.asarray(rng.normal(size=(FF, D)) * 0.02, bf16)
+
+    mlp_vg = jax.jit(jax.grad(
+        lambda xx, a, b: (jax.nn.gelu(xx @ a) @ b)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    add("mlp_per_layer", mlp_vg, (x, w1, w2),
+        3 * (2 * N * D * FF + 2 * N * FF * D))
+
+    # 3) QKV + output projections (4 D x D matmuls) fwd+bwd.
+    wq = jnp.asarray(rng.normal(size=(4, D, D)) * 0.02, bf16)
+
+    proj_vg = jax.jit(jax.grad(
+        lambda xx, w: sum((xx @ w[i]).astype(jnp.float32).sum()
+                          for i in range(4)), argnums=(0, 1)))
+    add("qkvo_projections_per_layer", proj_vg, (x, wq),
+        3 * 4 * 2 * N * D * D)
+
+    # 4) vocab head + CE (the XLA-fused jnp path the step uses).
+    from tpu_dist.ops.losses import sparse_categorical_crossentropy
+
+    wv = jnp.asarray(rng.normal(size=(D, V)) * 0.02, bf16)
+    yids = jnp.asarray(rng.integers(0, V, size=(N,)), jnp.int32)
+
+    def head_ce(xx, w):
+        logits = (xx @ w).astype(jnp.float32)
+        return sparse_categorical_crossentropy(
+            logits, yids, from_logits=True).mean()
+
+    ce_vg = jax.jit(jax.grad(head_ce, argnums=(0, 1)))
+    add("vocab_head_plus_ce", ce_vg, (x, wv), 3 * 2 * N * D * V)
+
+    # 4b) the fused Pallas CE at the same vocab, for the record.
+    try:
+        from tpu_dist.ops.pallas_kernels import fused_sparse_cross_entropy
+
+        def head_ce_fused(xx, w):
+            logits = (xx @ w).astype(jnp.float32)
+            return fused_sparse_cross_entropy(logits, yids).mean()
+
+        fce_vg = jax.jit(jax.grad(head_ce_fused, argnums=(0, 1)))
+        add("vocab_head_plus_ce_fused_pallas", fce_vg, (x, wv),
+            3 * 2 * N * D * V)
+    except Exception as e:  # noqa: BLE001 - audit records, never dies
+        rows["vocab_head_plus_ce_fused_pallas"] = {"error": str(e)[:200]}
+
+    # 5) LayerNorm fwd+bwd (bytes-bound; MFU column is near-zero by
+    # construction — its ms is what matters).
+    gamma = jnp.ones((D,), bf16)
+    beta = jnp.zeros((D,), bf16)
+
+    def ln(xx, g, b2):
+        xf = xx.astype(jnp.float32)
+        mu = xf.mean(-1, keepdims=True)
+        var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+        return (((xf - mu) * jax.lax.rsqrt(var + 1e-5))
+                * g.astype(jnp.float32) + b2.astype(jnp.float32)).sum()
+
+    ln_vg = jax.jit(jax.grad(ln, argnums=(0, 1, 2)))
+    add("layernorm_once", ln_vg, (x, gamma, beta), 3 * 10.0 * N * D)
+
+    return rows
+
+
+def full_step():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from tpu_dist.models.policy import set_policy
+    from tpu_dist.parallel.strategy import MirroredStrategy
+
+    set_policy("mixed_bfloat16")
+    strategy = MirroredStrategy()
+    with strategy.scope():
+        model = bench.build_model("transformer_lm", (L,))
+    x, y = bench.load_batch("synthetic_tokens", (L,), B)
+    xb = strategy.distribute_batch(x)
+    yb = strategy.distribute_batch(y)
+    key = jax.random.PRNGKey(0)
+    fn = model.make_train_function(steps_per_execution=1)
+    st = jax.tree.map(jnp.copy, model.train_state())
+
+    out = fn(*st, xb, yb, key)
+    jax.device_get(out[0])
+    st = out[1:]
+    best = float("inf")
+    for _ in range(8):
+        t0 = time.perf_counter()
+        out = fn(*st, xb, yb, key)
+        st = out[1:]
+        jax.device_get(out[0])
+        best = min(best, time.perf_counter() - t0)
+    step_ms = best * 1e3
+
+    from tpu_dist.ops import flash_attention as fa
+
+    flops = bench._flops_per_step(model, strategy, (L,), B,
+                                  token_model=True)
+    if flops:
+        # cost_analysis scores the Pallas flash custom call as 0 FLOPs;
+        # add the analytic attention model FLOPs (bench.py's rule).
+        flops += DEPTH * fa.analytic_train_flops(B, H, L, D // H,
+                                                 causal=True)
+    return {
+        "step_ms": round(step_ms, 3),
+        "model_gflops": round(flops / 1e9, 1) if flops else None,
+        "mfu_pct": round(flops / (step_ms / 1e3)
+                         / (BF16_PEAK_TFLOPS * 1e12) * 100, 1)
+        if flops else None,
+    }
+
+
+def main() -> int:
+    rows = component_rows()
+    step = full_step()
+
+    per_layer = ("flash_attention_per_layer", "mlp_per_layer",
+                 "qkvo_projections_per_layer")
+    sum_ms = sum(rows[k]["ms"] for k in per_layer) * DEPTH
+    sum_ms += rows["vocab_head_plus_ce"]["ms"]
+    sum_ms += rows["layernorm_once"]["ms"] * (2 * DEPTH + 1)
+    model_gf = (sum(rows[k]["model_gflops"] for k in per_layer) * DEPTH
+                + rows["vocab_head_plus_ce"]["model_gflops"])
+
+    out = {
+        "shapes": {"batch": B, "seq": L, "d_model": D, "heads": H,
+                   "ff": FF, "vocab": V, "depth": DEPTH,
+                   "policy": "mixed_bfloat16"},
+        "components": rows,
+        "full_step": step,
+        "sum_of_parts_ms": round(sum_ms, 2),
+        "sum_of_parts_model_gflops": round(model_gf, 1),
+        "implied_ceiling_mfu_pct": round(
+            model_gf / sum_ms * 1e6 / (BF16_PEAK_TFLOPS * 1e9) * 100, 1),
+        "note": (
+            "implied_ceiling = MFU if the full step cost exactly the sum "
+            "of isolated components (no fusion wins/losses, free "
+            "optimizer+dispatch). Component mfu_pct uses each part's own "
+            "analytic model FLOPs (fwd + 2x bwd convention)."),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lm_audit_r5.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
