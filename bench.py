@@ -1015,8 +1015,16 @@ def driver_run() -> int:
     # variance is large; best-of-5 makes the headline robust to neighbors.
     # spe=64 (r4 A/B: 0.29 ms/step vs 0.60 at spe=16 — the step is
     # dispatch-bound, deeper scanning halves the amortized dispatch).
-    headline = run_step_bench("mnist_cnn", steps=512, warmup=64,
-                              global_batch=128, spe=64, repeats=5)
+    # The tunnel can also be DOWN (observed r5: 'Unable to initialize
+    # backend axon: UNAVAILABLE' mid-day) — a dead chip must still
+    # produce the one parseable stdout line, with the failure recorded.
+    try:
+        headline = run_step_bench("mnist_cnn", steps=512, warmup=64,
+                                  global_batch=128, spe=64, repeats=5)
+    except Exception as e:
+        headline = {"images_per_sec_per_core": None,
+                    "steps_per_execution": 64,
+                    "error": f"{type(e).__name__}: {e}"[:500]}
     print(json.dumps(headline), file=sys.stderr)
 
     sections = {
@@ -1108,7 +1116,9 @@ def driver_run() -> int:
 
     line = {
         "metric": "mnist_cnn_images_per_sec_per_core",
-        "value": headline["images_per_sec_per_core"],
+        "value": headline.get("images_per_sec_per_core"),
+        **({"chip_error": headline["error"]}
+           if "error" in headline else {}),
         "unit": "images/sec/core",
         "steps_per_execution": headline["steps_per_execution"],
         "mfu_pct": headline.get("mfu_pct"),
