@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Repo gate: shardcheck static analysis, then the tier-1 test suite.
+#
+# Usage: scripts/check.sh
+#
+# Step 1 runs `python -m tpu_dist.analysis` over the package and fails on
+# any error-severity finding (the dogfooded self-check — see README.md
+# "Static analysis"). Step 2 is the tier-1 pytest command from ROADMAP.md.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== shardcheck: static sharding/collective analysis =="
+JAX_PLATFORMS=cpu python -m tpu_dist.analysis tpu_dist/ --fail-on error \
+  || { echo "check.sh: shardcheck found error-severity findings" >&2; exit 1; }
+
+echo "== tier-1 tests =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit "$rc"

@@ -172,14 +172,21 @@ def selftest(out: pathlib.Path) -> None:
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    # choices= is validated by hand: with nargs="*" Python 3.10's argparse
+    # checks the empty default list itself against choices and rejects it
+    # (bpo-27227 family), breaking bare `fetch_data.py --selftest`.
     parser.add_argument("datasets", nargs="*",
-                        choices=["mnist", "fashion_mnist", "cifar10"],
+                        metavar="{mnist,fashion_mnist,cifar10}",
                         help="datasets to fetch (default: mnist)")
     parser.add_argument("--dir", default="./tpu_dist_data",
                         help="output directory (point $TPU_DIST_DATA_DIR here)")
     parser.add_argument("--selftest", action="store_true",
                         help="no-network round-trip check of the convert path")
     args = parser.parse_args(argv)
+    for name in args.datasets:
+        if name not in ("mnist", "fashion_mnist", "cifar10"):
+            parser.error(f"argument datasets: invalid choice: {name!r} "
+                         "(choose from 'mnist', 'fashion_mnist', 'cifar10')")
     out = pathlib.Path(args.dir).expanduser()
 
     if args.selftest:

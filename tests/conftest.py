@@ -39,6 +39,10 @@ def pytest_configure(config):
         "markers",
         "realdata: needs real datasets under $TPU_DIST_DATA_DIR "
         "(populate with scripts/fetch_data.py; skipped otherwise)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long builds/runs (e.g. sanitizer rebuilds); excluded from "
+        "the tier-1 gate, run explicitly with -m slow")
 
 
 @pytest.fixture(scope="session")

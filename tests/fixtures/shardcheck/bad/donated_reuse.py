@@ -1,0 +1,21 @@
+"""shardcheck bad fixture: donated buffer read after donation (SC104).
+
+``params`` is donated to the jitted update, then read again for logging —
+on hardware that honours donation the second read hits a freed buffer.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def update(params, grads):
+    return params - 0.1 * grads
+
+
+update_jit = jax.jit(update, donate_argnums=0)
+
+
+def train_once(params, grads):
+    new_params = update_jit(params, grads)
+    stale_norm = jnp.linalg.norm(params)
+    return new_params, stale_norm

@@ -1,0 +1,19 @@
+"""shardcheck bad fixture: PartitionSpec arity exceeds array rank (SC102).
+
+A rank-2 array placed with a 3-entry spec — XLA rejects this at run time.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def place(mesh):
+    x = jnp.zeros((8, 4))
+    return jax.device_put(x, jax.sharding.NamedSharding(
+        mesh, P("data", "model", None)))
+
+
+def constrain():
+    y = jnp.ones((16, 16))
+    return jax.lax.with_sharding_constraint(y, P("data", None, "model"))

@@ -1,0 +1,23 @@
+"""shardcheck bad fixture: host side effects inside jit (SC103).
+
+print fires once at trace time, time.time is frozen into the compiled
+program, and stdlib random becomes a baked-in constant.
+"""
+
+import random
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_step(x):
+    print("step input:", x)
+    started = time.time()
+    jitter = random.random()
+    return x * jitter + started
+
+
+def make_scaled():
+    return jax.jit(lambda v: v * random.random())

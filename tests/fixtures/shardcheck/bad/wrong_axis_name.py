@@ -1,0 +1,19 @@
+"""shardcheck bad fixture: collective over an undeclared axis (SC101).
+
+The file declares a mesh over "data" but psums over "batch" — nothing in
+the file or the canonical axis set defines it.
+"""
+
+import jax
+import jax.numpy as jnp
+
+DATA_AXIS = "data"
+
+
+def replica_mean(x):
+    total = jax.lax.psum(x, "batch")
+    return total / jax.lax.axis_size("batch")
+
+
+def gather_batch(x):
+    return jax.lax.all_gather(jnp.sin(x), "batch")

@@ -1,0 +1,29 @@
+"""shardcheck good fixture: collectives over declared axes only (SC101 clean).
+
+Axes come from canonical constants, a file-local *_AXIS constant, and a
+mesh literal — all three declaration styles the rule recognises.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from tpu_dist.parallel.axes import DATA_AXIS
+
+LOCAL_AXIS = "replica"
+
+
+def make_mesh_spec():
+    return {"data": 4, "replica": 2}
+
+
+def replica_mean(x):
+    total = jax.lax.psum(x, DATA_AXIS)
+    return total / jax.lax.axis_size(DATA_AXIS)
+
+
+def gather_local(x):
+    return jax.lax.all_gather(jnp.sin(x), LOCAL_AXIS)
+
+
+def ring_shift(x):
+    return jax.lax.ppermute(x, "data", [(0, 1), (1, 2), (2, 3), (3, 0)])

@@ -1,0 +1,22 @@
+"""shardcheck good fixture: donation with immediate rebinding (SC104 clean).
+
+``params = update_jit(params, grads)`` hands the old buffer to XLA and
+rebinds the name to the result in the same statement — the donated value
+is never read again.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def update(params, grads):
+    return params - 0.1 * grads
+
+
+update_jit = jax.jit(update, donate_argnums=0)
+
+
+def train_once(params, grads):
+    params = update_jit(params, grads)
+    new_norm = jnp.linalg.norm(params)
+    return params, new_norm

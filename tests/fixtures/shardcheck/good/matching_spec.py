@@ -1,0 +1,22 @@
+"""shardcheck good fixture: PartitionSpec arity matches array rank (SC102
+clean). Rank-2 arrays get at most 2-entry specs; a rank-3 activation gets
+a 3-entry spec."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def place(mesh):
+    x = jnp.zeros((8, 4))
+    return jax.device_put(x, jax.sharding.NamedSharding(mesh, P("data", None)))
+
+
+def constrain():
+    y = jnp.ones((16, 16))
+    return jax.lax.with_sharding_constraint(y, P("data", "model"))
+
+
+def constrain_activations():
+    acts = jnp.zeros((8, 128, 512))
+    return jax.lax.with_sharding_constraint(acts, P("data", None, "model"))

@@ -1,0 +1,23 @@
+"""shardcheck good fixture: jitted functions stay pure (SC103 clean).
+
+Randomness goes through jax.random with an explicit key; timing and
+logging happen outside the jitted function.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, key):
+    noise = jax.random.normal(key, x.shape)
+    return x + 0.01 * noise
+
+
+def timed_step(x, key):
+    started = time.time()
+    out = step(x, key)
+    print("step took", time.time() - started)
+    return out

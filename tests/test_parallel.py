@@ -123,7 +123,9 @@ class TestCollectives:
         np.testing.assert_allclose(np.asarray(g_dist), g_ref, rtol=1e-5)
 
     def test_all_reduce_ops_under_shard_map(self, eight_devices):
-        from jax import shard_map
+        from tpu_dist.parallel.mesh import get_shard_map
+
+        shard_map = get_shard_map()
 
         mesh = make_mesh()
         x = np.arange(8, dtype=np.float32)
@@ -144,7 +146,9 @@ class TestCollectives:
 
     def test_mean_is_sum_div_group_size(self, eight_devices):
         # MEAN = SUM / group_size (tf:...cross_device_ops.py:1170-1180).
-        from jax import shard_map
+        from tpu_dist.parallel.mesh import get_shard_map
+
+        shard_map = get_shard_map()
 
         mesh = make_mesh()
         x = np.random.RandomState(2).randn(8).astype(np.float32)

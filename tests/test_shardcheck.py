@@ -1,0 +1,164 @@
+"""shardcheck (tpu_dist.analysis) tests: every advertised rule over the
+known-bad/known-good fixture programs, CLI exit-code contract, suppression
+syntax, and the dogfooded self-check over the repo itself.
+
+Assertions are on rule IDs, never message text — messages may be reworded
+freely without breaking these tests.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from tpu_dist.analysis import RULES, lint_file
+from tpu_dist.analysis.cli import main as shardcheck_main
+from tpu_dist.analysis.report import exit_code
+from tpu_dist.analysis.rules import Severity
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures" / "shardcheck"
+BAD = FIXTURES / "bad"
+GOOD = FIXTURES / "good"
+PKG = pathlib.Path(__file__).resolve().parents[1] / "tpu_dist"
+
+#: AST-pass fixtures: file -> exactly the rule IDs it must trip.
+BAD_AST = {
+    "wrong_axis_name.py": {"SC101"},
+    "rank_mismatch_spec.py": {"SC102"},
+    "side_effect_in_jit.py": {"SC103"},
+    "donated_reuse.py": {"SC104"},
+}
+GOOD_AST = ["declared_axis.py", "matching_spec.py", "pure_jit.py",
+            "donate_rebind.py"]
+
+
+def _cli_json(capsys, argv):
+    """Run the CLI in-process with --json; return (exit_code, payload)."""
+    rc = shardcheck_main(argv + ["--json"])
+    payload = json.loads(capsys.readouterr().out)
+    return rc, payload
+
+
+def _rule_ids(payload):
+    return {f["rule_id"] for f in payload["findings"]}
+
+
+class TestAstRules:
+    @pytest.mark.parametrize("name,expected", sorted(BAD_AST.items()))
+    def test_bad_fixture_flags_exactly_its_rule(self, name, expected):
+        findings = lint_file(str(BAD / name))
+        assert {f.rule_id for f in findings} == expected
+
+    @pytest.mark.parametrize("name", GOOD_AST)
+    def test_good_fixture_is_clean(self, name):
+        assert lint_file(str(GOOD / name)) == []
+
+    def test_suppression_comment_silences_rule(self, tmp_path):
+        f = tmp_path / "suppressed.py"
+        f.write_text(
+            "import jax\n"
+            "def bad(x):\n"
+            "    return jax.lax.psum(x, 'nope')"
+            "  # shardcheck: disable=SC101 -- test axis, mesh built elsewhere\n")
+        assert lint_file(str(f)) == []
+        # Without the pragma the same program is flagged.
+        g = tmp_path / "unsuppressed.py"
+        g.write_text(
+            "import jax\n"
+            "def bad(x):\n"
+            "    return jax.lax.psum(x, 'nope')\n")
+        assert {x.rule_id for x in lint_file(str(g))} == {"SC101"}
+
+    def test_unparseable_file_degrades_to_sc900(self, tmp_path):
+        f = tmp_path / "broken.py"
+        f.write_text("def oops(:\n")
+        findings = lint_file(str(f))
+        assert [x.rule_id for x in findings] == ["SC900"]
+        assert findings[0].severity == Severity.INFO
+        # Info findings pass the default gate but fail --fail-on info.
+        assert exit_code(findings, fail_on="error") == 0
+        assert exit_code(findings, fail_on="info") == 1
+
+
+class TestJaxprRules:
+    def test_branch_collective_fixture_flags_sc201(self, capsys,
+                                                   eight_devices):
+        rc, payload = _cli_json(
+            capsys, [str(BAD / "branch_collective.py")])
+        assert rc == 1
+        assert "SC201" in _rule_ids(payload)
+
+    def test_uniform_branches_fixture_is_clean(self, capsys, eight_devices):
+        rc, payload = _cli_json(
+            capsys, [str(GOOD / "uniform_branches.py")])
+        assert rc == 0
+        assert payload["findings"] == []
+
+
+class TestCliContract:
+    @pytest.mark.parametrize("name", sorted(BAD_AST))
+    def test_bad_fixture_exits_nonzero(self, capsys, name):
+        rc, payload = _cli_json(capsys, [str(BAD / name), "--no-trace"])
+        assert rc == 1
+        assert payload["exit_code"] == 1
+
+    def test_good_dir_exits_zero_without_trace(self, capsys):
+        rc, payload = _cli_json(capsys, [str(GOOD), "--no-trace"])
+        assert rc == 0
+        assert payload["findings"] == []
+
+    def test_fail_on_never_reports_but_passes(self, capsys):
+        rc, payload = _cli_json(
+            capsys, [str(BAD / "wrong_axis_name.py"), "--no-trace",
+                     "--fail-on", "never"])
+        assert rc == 0
+        assert "SC101" in _rule_ids(payload)
+
+    def test_json_payload_shape(self, capsys):
+        rc, payload = _cli_json(
+            capsys, [str(BAD / "donated_reuse.py"), "--no-trace"])
+        assert payload["tool"] == "shardcheck"
+        assert set(payload["counts"]) == {"info", "warning", "error"}
+        finding = payload["findings"][0]
+        assert {"rule_id", "severity", "path", "line", "col",
+                "message"} <= set(finding)
+
+    def test_every_advertised_rule_has_flagging_and_clean_coverage(
+            self, capsys, eight_devices):
+        advertised = set(RULES)
+        flagged = set()
+        for name in BAD_AST:
+            flagged |= {f.rule_id for f in lint_file(str(BAD / name))}
+        rc, payload = _cli_json(capsys, [str(BAD / "branch_collective.py")])
+        flagged |= _rule_ids(payload)
+        # SC900 is the degradation rule; its flagging fixture is synthetic
+        # (test_unparseable_file_degrades_to_sc900) to keep bad/ all-error.
+        assert advertised - {"SC900"} <= flagged
+        # Every good fixture is clean of every rule, trace pass included.
+        rc, payload = _cli_json(capsys, [str(GOOD)])
+        assert rc == 0
+        assert payload["findings"] == []
+
+
+class TestDogfood:
+    def test_repo_lints_clean(self):
+        findings = [f for p in (PKG,)
+                    for f in lint_file(str(p))] if PKG.is_file() else None
+        # Directory lint via the public API, error severity must be absent.
+        from tpu_dist.analysis import lint_paths
+
+        findings = lint_paths([str(PKG)])
+        errors = [f for f in findings if f.severity >= Severity.ERROR]
+        assert errors == [], [f.render() for f in errors]
+
+    def test_cli_self_check_exits_zero(self):
+        # The acceptance-criterion invocation, end to end in a fresh
+        # interpreter: AST lint + built-in entry-point traces over the
+        # installed package.
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.analysis", str(PKG)],
+            capture_output=True, text=True, timeout=600,
+            cwd=str(PKG.parent))
+        assert proc.returncode == 0, proc.stdout + proc.stderr

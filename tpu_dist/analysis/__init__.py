@@ -1,0 +1,41 @@
+"""tpu_dist.analysis — "shardcheck", the static sharding/collective checker.
+
+The TF reference bought its distributed-correctness guarantees from runtime
+machinery (MultiWorkerMirroredStrategy ordering every collective launch);
+the TPU-native port moves that surface into axis names, PartitionSpecs and
+jitted step functions, where a mistake compiles fine and corrupts training
+or deadlocks at run time. This subsystem catches those mistakes before a
+TPU-hour is spent:
+
+* :mod:`~tpu_dist.analysis.ast_lint` — source-level rules SC101-SC104
+  (unknown collective axis, PartitionSpec/rank mismatch, host side effects
+  under jit, donated-buffer reuse);
+* :mod:`~tpu_dist.analysis.jaxpr_checks` — rule SC201 (collective-order
+  divergence across cond/switch branches) over CPU-traced entry points;
+* :mod:`~tpu_dist.analysis.rules` / :mod:`~tpu_dist.analysis.report` —
+  the rule catalogue, suppressions, JSON/text output, exit-code policy;
+* :mod:`~tpu_dist.analysis.cli` — ``python -m tpu_dist.analysis [paths]``.
+
+See README.md "Static analysis" for the CLI and rule catalogue;
+``scripts/check.sh`` wires the checker in front of the tier-1 test gate.
+"""
+
+from tpu_dist.analysis.ast_lint import lint_file, lint_paths
+from tpu_dist.analysis.cli import main
+from tpu_dist.analysis.jaxpr_checks import (
+    check_branch_collectives,
+    check_callable,
+    collective_sequence,
+    run_entry_points,
+)
+from tpu_dist.analysis.report import exit_code, to_json_dict
+from tpu_dist.analysis.rules import RULES, Finding, Rule, Severity
+
+__all__ = [
+    "RULES", "Finding", "Rule", "Severity",
+    "lint_file", "lint_paths",
+    "check_branch_collectives", "check_callable", "collective_sequence",
+    "run_entry_points",
+    "exit_code", "to_json_dict",
+    "main",
+]

@@ -1,0 +1,8 @@
+"""``python -m tpu_dist.analysis`` entry point."""
+
+import sys
+
+from tpu_dist.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

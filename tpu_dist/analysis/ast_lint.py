@@ -1,0 +1,502 @@
+"""shardcheck AST lint pass — sharding/collective misuse caught before trace.
+
+Walks Python source (no import, no jax initialization) and flags the
+mistake classes that compile fine and fail only on the machine:
+
+* **SC101** — collectives whose axis-name argument resolves to a string
+  that no mesh declares: not canonical (``tpu_dist/parallel/axes.py``),
+  not a ``*_AXIS`` constant in the file, not in a mesh/``axis_shapes``
+  literal, not an ``axis_name=`` parameter default.
+* **SC102** — ``PartitionSpec`` arity exceeding the rank of the array it
+  places (``device_put`` / ``with_sharding_constraint`` with an inline
+  spec over an array whose constructor shape is visible).
+* **SC103** — host side effects (``print``, ``time.time``, stdlib
+  ``random``, ``input``/``breakpoint``) inside jitted functions: they run
+  once at trace time, not per step.
+* **SC104** — reads of a buffer after it was donated to a
+  ``jit(donate_argnums=...)`` call in the same scope.
+
+The pass is deliberately conservative: an axis name or array rank it
+cannot resolve statically is skipped, never guessed. Findings carry rule
+IDs from :mod:`tpu_dist.analysis.rules`; inline suppressions
+(``# shardcheck: disable=SC101  -- why``) are honored per line.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from tpu_dist.analysis.rules import Finding, apply_suppressions
+from tpu_dist.parallel.axes import CANONICAL_AXES
+
+#: Collective call -> positional index of its axis-name argument.
+#: Covers jax.lax primitives and this repo's wrappers (collectives.py).
+_COLLECTIVE_AXIS_POS = {
+    "psum": 1,
+    "pmean": 1,
+    "pmax": 1,
+    "pmin": 1,
+    "all_gather": 1,
+    "all_to_all": 1,
+    "ppermute": 1,
+    "pshuffle": 1,
+    "psum_scatter": 1,
+    "axis_index": 0,
+    "axis_size": 0,
+    "all_reduce": 1,  # tpu_dist.parallel.collectives wrapper
+}
+
+#: Call roots accepted for the collective table — bare names (from-import)
+#: always match; dotted calls must come through one of these modules.
+_COLLECTIVE_ROOTS = ("jax.lax", "jax", "lax", "tpu_dist.parallel",
+                     "collectives")
+
+_ARRAY_CTOR_SHAPE_POS = {
+    "zeros": 0, "ones": 0, "empty": 0, "full": 0,
+    "normal": 1, "uniform": 1, "bernoulli": 2, "truncated_normal": 3,
+}
+
+_TIME_EFFECTS = {"time.time", "time.perf_counter", "time.monotonic",
+                 "time.time_ns", "time.perf_counter_ns"}
+
+
+def _collect_aliases(tree: ast.Module) -> dict:
+    """Local name -> dotted origin, from import statements."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+    return aliases
+
+
+def _dotted(node: ast.AST, aliases: dict) -> Optional[str]:
+    """Resolve an expression to a dotted path through import aliases, or
+    None for anything not a plain Name/Attribute chain."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(aliases.get(node.id, node.id))
+    return ".".join(reversed(parts))
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class _FileLint(ast.NodeVisitor):
+    """One file's lint state; produces findings via run()."""
+
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.aliases = _collect_aliases(tree)
+        self.findings: list[Finding] = []
+        #: module-level `NAME = "str"` assignments (axis-name resolution).
+        self.str_consts: dict[str, str] = {}
+        self.declared_axes: set[str] = set(CANONICAL_AXES)
+
+    # -- shared helpers -------------------------------------------------------
+
+    def _flag(self, rule_id: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule_id, self.path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), message))
+
+    def _call_tail(self, call: ast.Call) -> Optional[str]:
+        dotted = _dotted(call.func, self.aliases)
+        return dotted.rsplit(".", 1)[-1] if dotted else None
+
+    # -- declaration collection (SC101 context) -------------------------------
+
+    def _collect_declarations(self) -> None:
+        for node in ast.walk(self.tree):
+            # *_AXIS = "name" string constants (any scope).
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                s = _str_const(node.value)
+                if isinstance(t, ast.Name) and s is not None:
+                    self.str_consts.setdefault(t.id, s)
+                    if t.id.upper().endswith("AXIS"):
+                        self.declared_axes.add(s)
+            elif isinstance(node, ast.Call):
+                tail = self._call_tail(node)
+                # make_mesh({'data': ..}) / Mesh(devices, ('data', ..)) /
+                # axis_shapes={...} kwarg anywhere.
+                for kw in node.keywords:
+                    if kw.arg in ("axis_shapes", "axis_names"):
+                        self._declare_from_literal(kw.value)
+                if tail in ("make_mesh",) and node.args:
+                    self._declare_from_literal(node.args[0])
+                if tail in ("Mesh", "AbstractMesh") and len(node.args) >= 2:
+                    self._declare_from_literal(node.args[1])
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # axis_name="..." style parameter defaults.
+                args = node.args
+                for name, default in zip(
+                        [a.arg for a in args.args[-len(args.defaults):]]
+                        if args.defaults else [], args.defaults):
+                    s = _str_const(default)
+                    if s is not None and "axis" in name.lower():
+                        self.declared_axes.add(s)
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    s = _str_const(d) if d is not None else None
+                    if s is not None and "axis" in a.arg.lower():
+                        self.declared_axes.add(s)
+
+    def _declare_from_literal(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Dict):
+            for k in node.keys:
+                s = _str_const(k) if k is not None else None
+                if s is not None:
+                    self.declared_axes.add(s)
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                s = _str_const(e)
+                if s is not None:
+                    self.declared_axes.add(s)
+
+    # -- SC101 ----------------------------------------------------------------
+
+    def _axis_strings(self, node: ast.AST) -> list[str]:
+        """String axis names an axis argument resolves to ([] if opaque)."""
+        s = _str_const(node)
+        if s is not None:
+            return [s]
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                out.extend(self._axis_strings(e))
+            return out
+        if isinstance(node, ast.Name) and node.id in self.str_consts:
+            return [self.str_consts[node.id]]
+        return []  # parameter, attribute, computed — not statically visible
+
+    def _check_collectives(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func, self.aliases)
+            if dotted is None:
+                continue
+            root, _, tail = dotted.rpartition(".")
+            if tail not in _COLLECTIVE_AXIS_POS:
+                continue
+            if root and not any(root == r or root.startswith(r + ".")
+                                for r in _COLLECTIVE_ROOTS):
+                continue
+            pos = _COLLECTIVE_AXIS_POS[tail]
+            axis_arg = None
+            for kw in node.keywords:
+                if kw.arg in ("axis_name", "axis"):
+                    axis_arg = kw.value
+            if axis_arg is None and len(node.args) > pos:
+                axis_arg = node.args[pos]
+            if axis_arg is None:
+                continue
+            for name in self._axis_strings(axis_arg):
+                if name not in self.declared_axes:
+                    self._flag(
+                        "SC101", node,
+                        f"{tail}() over axis {name!r}, which no mesh in "
+                        f"scope declares (known axes: "
+                        f"{sorted(self.declared_axes)}); a typo here "
+                        "deadlocks or mis-reduces at run time")
+
+    # -- SC102 ----------------------------------------------------------------
+
+    def _spec_arity(self, node: ast.AST) -> Optional[int]:
+        """Entry count of an inline PartitionSpec(...) / NamedSharding(mesh,
+        PartitionSpec(...)) expression, else None."""
+        if not isinstance(node, ast.Call):
+            return None
+        tail = self._call_tail(node)
+        if tail in ("PartitionSpec", "P"):
+            dotted = _dotted(node.func, self.aliases) or ""
+            if tail == "P" and "PartitionSpec" not in dotted:
+                return None  # a P that isn't a PartitionSpec alias
+            return len(node.args)
+        if tail == "NamedSharding" and len(node.args) >= 2:
+            return self._spec_arity(node.args[1])
+        return None
+
+    def _shape_rank(self, node: ast.AST) -> Optional[int]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return len(node.elts)
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return 1
+        return None
+
+    def _check_spec_ranks(self) -> None:
+        for scope in self._scopes():
+            ranks: dict[str, int] = {}
+            for node in self._scope_walk(scope):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name) \
+                        and isinstance(node.value, ast.Call):
+                    rank = self._ctor_rank(node.value)
+                    if rank is not None:
+                        ranks[node.targets[0].id] = rank
+            for node in self._scope_walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = self._call_tail(node)
+                if tail not in ("device_put", "with_sharding_constraint"):
+                    continue
+                if len(node.args) < 2:
+                    continue
+                target, spec = node.args[0], node.args[1]
+                arity = self._spec_arity(spec)
+                if arity is None:
+                    continue
+                rank = None
+                if isinstance(target, ast.Name):
+                    rank = ranks.get(target.id)
+                elif isinstance(target, ast.Call):
+                    rank = self._ctor_rank(target)
+                if rank is not None and arity > rank:
+                    self._flag(
+                        "SC102", node,
+                        f"PartitionSpec with {arity} entries placed on a "
+                        f"rank-{rank} array; a spec may not name more "
+                        "axes than the array has dimensions")
+
+    def _ctor_rank(self, call: ast.Call) -> Optional[int]:
+        tail = self._call_tail(call)
+        if tail == "arange" or tail == "linspace":
+            return 1
+        pos = _ARRAY_CTOR_SHAPE_POS.get(tail or "")
+        if pos is None:
+            return None
+        shape = None
+        for kw in call.keywords:
+            if kw.arg == "shape":
+                shape = kw.value
+        if shape is None and len(call.args) > pos:
+            shape = call.args[pos]
+        return self._shape_rank(shape) if shape is not None else None
+
+    def _scopes(self) -> Iterable[ast.AST]:
+        yield self.tree
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    @staticmethod
+    def _scope_walk(scope: ast.AST) -> Iterable[ast.AST]:
+        """Walk one scope WITHOUT descending into nested functions — those
+        are separate entries in _scopes(), and visiting them from the
+        enclosing scope too would double-report their findings."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    # -- SC103 ----------------------------------------------------------------
+
+    def _jitted_functions(self) -> list[ast.AST]:
+        """FunctionDefs that are jitted: @jit-decorated, or wrapped via a
+        visible jax.jit(fn, ...) call in the file."""
+        by_name: dict[str, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, node)
+        jitted: list[ast.AST] = []
+
+        def is_jit_expr(expr: ast.AST) -> bool:
+            dotted = _dotted(expr, self.aliases)
+            if dotted and dotted.rsplit(".", 1)[-1] == "jit":
+                return True
+            # @partial(jax.jit, ...) / functools.partial(jit, ...)
+            if isinstance(expr, ast.Call):
+                d = _dotted(expr.func, self.aliases)
+                if d and d.rsplit(".", 1)[-1] == "partial" and expr.args:
+                    return is_jit_expr(expr.args[0])
+                return is_jit_expr(expr.func)
+            return False
+
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(is_jit_expr(d) for d in node.decorator_list):
+                    jitted.append(node)
+            elif isinstance(node, ast.Call) and is_jit_expr(node.func):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name) and arg.id in by_name:
+                        jitted.append(by_name[arg.id])
+                    elif isinstance(arg, ast.Lambda):
+                        jitted.append(arg)
+        return jitted
+
+    def _check_jit_side_effects(self) -> None:
+        seen: set[int] = set()
+        for fn in self._jitted_functions():
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            fn_name = getattr(fn, "name", "<lambda>")
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted(node.func, self.aliases)
+                if dotted is None:
+                    continue
+                effect = None
+                if dotted in ("print", "input", "breakpoint"):
+                    effect = f"{dotted}()"
+                elif dotted in _TIME_EFFECTS:
+                    effect = f"{dotted}() (traces to a constant)"
+                elif dotted.startswith("random."):
+                    effect = (f"{dotted}() (Python-level randomness is "
+                              "baked in at trace time; use jax.random)")
+                if effect is not None:
+                    self._flag(
+                        "SC103", node,
+                        f"host side effect {effect} inside jitted "
+                        f"function {fn_name!r}: runs once at trace time, "
+                        "not per step")
+
+    # -- SC104 ----------------------------------------------------------------
+
+    def _check_donated_reuse(self) -> None:
+        # Donating wrappers are collected file-wide: `u = jit(f,
+        # donate_argnums=0)` at module level is typically CALLED from inside
+        # functions, so the wrapper and the reuse live in different scopes.
+        donating: dict[str, tuple] = {}  # fn name -> donated positions
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call):
+                positions = self._donate_positions(node.value)
+                if positions:
+                    donating[node.targets[0].id] = positions
+        if not donating:
+            return
+        for scope in self._scopes():
+            self._scan_donations(getattr(scope, "body", []), donating)
+
+    def _donate_positions(self, call: ast.Call) -> tuple:
+        tail = self._call_tail(call)
+        if tail != "jit":
+            return ()
+        for kw in call.keywords:
+            if kw.arg != "donate_argnums":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, int):
+                        out.append(e.value)
+                return tuple(out)
+        return ()
+
+    def _scan_donations(self, body, donating: dict) -> None:
+        """Linear scan of a statement list: donated names are dead after
+        the donating call until rebound; any read in a later statement is
+        a use-after-donate."""
+        donated: dict[str, int] = {}  # name -> donating line
+
+        def stmt_names(stmt):
+            loads, stores, donates = set(), set(), set()
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name):
+                    if isinstance(node.ctx, ast.Store):
+                        stores.add(node.id)
+                    elif isinstance(node.ctx, ast.Load):
+                        loads.add(node.id)
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name) and node.func.id in donating:
+                    for pos in donating[node.func.id]:
+                        if pos < len(node.args) and isinstance(
+                                node.args[pos], ast.Name):
+                            donates.add(node.args[pos].id)
+            return loads, stores, donates
+
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scope: its own pass
+            loads, stores, donates = stmt_names(stmt)
+            for name in sorted(loads):
+                if name in donated and name not in donates:
+                    self._flag(
+                        "SC104", stmt,
+                        f"{name!r} was donated to a jit(donate_argnums=...)"
+                        f" call on line {donated[name]} and read again "
+                        "here; the buffer now belongs to XLA — thread the "
+                        "returned value instead")
+                    del donated[name]  # one finding per donation
+            for name in donates:
+                donated[name] = stmt.lineno
+            for name in stores:
+                if name in donated and name not in donates:
+                    del donated[name]
+                elif name in donated and name in donates and isinstance(
+                        stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    # x = g(x): rebound to the returned value — safe.
+                    del donated[name]
+
+    # -- driver ---------------------------------------------------------------
+
+    def run(self) -> list[Finding]:
+        self._collect_declarations()
+        self._check_collectives()
+        self._check_spec_ranks()
+        self._check_jit_side_effects()
+        self._check_donated_reuse()
+        return self.findings
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    """Expand files/directories into a sorted list of .py files."""
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if not d.startswith(".")
+                               and d != "__pycache__"]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(dirpath, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return sorted(dict.fromkeys(out))
+
+
+def lint_file(path: str) -> list[Finding]:
+    """Lint one file; honors inline suppressions. Syntax errors come back
+    as an SC900 info finding rather than crashing the whole run."""
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding("SC900", path, e.lineno or 1, e.offset or 0,
+                        f"file does not parse: {e.msg}")]
+    findings = _FileLint(path, tree, source).run()
+    return apply_suppressions(findings, {path: source.splitlines()})
+
+
+def lint_paths(paths: Iterable[str]) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(lint_file(path))
+    return findings

@@ -1,0 +1,154 @@
+"""shardcheck CLI: ``python -m tpu_dist.analysis [paths]``.
+
+Two passes over the given paths (default: the installed ``tpu_dist``
+package):
+
+1. the AST lint (ast_lint.py) over every ``.py`` file — no imports, no
+   backend;
+2. unless ``--no-trace``: the jaxpr checks (jaxpr_checks.py) — the
+   built-in entry points (trainer step, both pipeline schedules) traced on
+   a forced-CPU backend, plus any analyzed module that defines a
+   ``shardcheck_entry()`` returning ``(fn, example_args)``.
+
+Exit code 1 when any finding reaches ``--fail-on`` severity (default:
+error), 0 otherwise — the contract ``scripts/check.sh`` builds on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import importlib.util
+import os
+import sys
+from typing import Optional
+
+from tpu_dist.analysis import ast_lint, report
+from tpu_dist.analysis.rules import Finding, apply_suppressions
+
+
+def _force_cpu_backend() -> None:
+    """Pin tracing to CPU with enough virtual devices for a 2-stage pipe
+    mesh. jax reads XLA_FLAGS at backend init and its platform config
+    lazily, so this works even though the package import already pulled in
+    jax — unless a backend was initialized first, in which case the entry
+    traces degrade to SC900 info findings on their own."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # pragma: no cover - leave the default backend
+        pass
+
+
+def _has_shardcheck_entry(path: str) -> bool:
+    """Cheap AST probe so only opted-in modules get imported."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read(), filename=path)
+    except (OSError, SyntaxError):
+        return False
+    return any(isinstance(node, (ast.FunctionDef,))
+               and node.name == "shardcheck_entry"
+               for node in tree.body)
+
+
+def _check_module_entry(path: str) -> list[Finding]:
+    """Import ``path`` and run jaxpr checks on its shardcheck_entry()."""
+    from tpu_dist.analysis import jaxpr_checks
+
+    name = "_shardcheck_" + os.path.splitext(
+        os.path.basename(path))[0]
+    try:
+        spec = importlib.util.spec_from_file_location(name, path)
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        fn, args = module.shardcheck_entry()
+        return jaxpr_checks.check_callable(
+            fn, tuple(args), label=f"{path}::shardcheck_entry", path=path)
+    except Exception as e:  # noqa: BLE001 - degrade, never crash the run
+        return [Finding(
+            "SC900", path, 1, 0,
+            f"shardcheck_entry() could not be traced "
+            f"({type(e).__name__}: {e})")]
+
+
+def _default_paths() -> list[str]:
+    """The installed package itself — the dogfood target."""
+    import tpu_dist
+
+    return [os.path.dirname(os.path.abspath(tpu_dist.__file__))]
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_dist.analysis",
+        description="shardcheck: static sharding/collective consistency "
+                    "checks for tpu_dist programs")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files or directories to check (default: the tpu_dist "
+             "package)")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable JSON on stdout instead of text")
+    parser.add_argument(
+        "--no-trace", action="store_true",
+        help="skip the jaxpr-level checks (AST lint only; no jax backend "
+             "touched)")
+    parser.add_argument(
+        "--fail-on", default="error",
+        choices=("error", "warning", "info", "never"),
+        help="lowest severity that makes the exit code non-zero "
+             "(default: error)")
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        report.render_rules()
+        return 0
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            parser.error(f"no such path: {p}")
+
+    findings = ast_lint.lint_paths(paths)
+
+    if not args.no_trace:
+        _force_cpu_backend()
+        from tpu_dist.analysis import jaxpr_checks
+
+        files = ast_lint.iter_python_files(paths)
+        # Built-in entry points run when the package under check is (or
+        # contains) tpu_dist itself — the dogfooded self-check.
+        if any(os.sep + "tpu_dist" + os.sep in os.path.abspath(f) + os.sep
+               or os.path.basename(f) == "trainer.py" for f in files):
+            findings.extend(jaxpr_checks.run_entry_points())
+        trace_findings = []
+        for f in files:
+            if _has_shardcheck_entry(f):
+                trace_findings.extend(_check_module_entry(f))
+        source_by_path = {}
+        for f in {t.path for t in trace_findings if os.path.exists(t.path)}:
+            with open(f, "r", encoding="utf-8") as fh:
+                source_by_path[f] = fh.read().splitlines()
+        findings.extend(apply_suppressions(trace_findings, source_by_path))
+
+    if args.json:
+        report.dump_json(report.to_json_dict(
+            findings, paths=paths, fail_on=args.fail_on))
+    else:
+        report.render_text(findings, paths=paths)
+    return report.exit_code(findings, fail_on=args.fail_on)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

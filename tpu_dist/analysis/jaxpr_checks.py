@@ -1,0 +1,238 @@
+"""shardcheck jaxpr-level checks — collective-order consistency under trace.
+
+The AST pass sees spelling; this pass sees the program XLA will actually
+partition. Representative entry points (the trainer step and both pipeline
+schedules) are traced on CPU with ``jax.make_jaxpr`` — tracing compiles
+nothing and needs no TPU — and the resulting jaxprs are walked for the
+deadlock-class bug the reference's TF runtime ordered away:
+
+**SC201 — collective-order divergence.** In an SPMD program every device
+runs the same instruction stream, so collectives pair up by construction —
+EXCEPT inside ``lax.cond``/``lax.switch``, where a device-varying predicate
+(``axis_index``-derived, the usual reason SPMD code branches at all) sends
+different devices down different branches. If those branches issue
+different collective sequences, the mismatched launches rendezvous with
+each other and the program deadlocks. This is why
+``pipeline_1f1b.one_f_one_b`` keeps its ``ppermute``s OUTSIDE the
+forward/backward/idle switch; the check pins that invariant for every
+entry point and every user program that registers one.
+
+User programs opt in by defining a module-level ``shardcheck_entry()``
+returning ``(fn, example_args)``; the CLI traces it and applies the same
+checks (see cli.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Iterable, Optional
+
+from tpu_dist.analysis.rules import Finding
+
+logger = logging.getLogger("tpu_dist.analysis")
+
+#: Primitive-name fragments that identify cross-device collectives in a
+#: jaxpr. Substring match keeps this robust across jax renames
+#: (psum/psum2/psum_invariant all count).
+_COLLECTIVE_FRAGMENTS = ("psum", "pmax", "pmin", "ppermute", "all_gather",
+                         "all_to_all", "pbroadcast", "reduce_scatter",
+                         "pgather", "pshuffle")
+
+
+def _is_collective(prim_name: str) -> bool:
+    return any(f in prim_name for f in _COLLECTIVE_FRAGMENTS)
+
+
+def _inner_jaxprs(params: dict):
+    """Sub-jaxprs of one eqn's params (branches, scan/while bodies,
+    shard_map/pjit bodies, custom_vjp closures, ...)."""
+    for value in params.values():
+        for item in (value if isinstance(value, (tuple, list)) else (value,)):
+            jaxpr = getattr(item, "jaxpr", item)
+            if hasattr(jaxpr, "eqns"):
+                yield jaxpr
+
+
+def collective_sequence(jaxpr) -> list[str]:
+    """Depth-first sequence of collective primitive names issued by a
+    jaxpr, descending into every sub-jaxpr (program launch order for
+    straight-line code; branch bodies contribute in branch order)."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    out: list[str] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if _is_collective(name):
+            axes = eqn.params.get("axes") or eqn.params.get("axis_name")
+            out.append(f"{name}[{axes}]" if axes else name)
+        for sub in _inner_jaxprs(eqn.params):
+            out.extend(collective_sequence(sub))
+    return out
+
+
+def check_branch_collectives(jaxpr, *, label: str,
+                             path: str = "<trace>") -> list[Finding]:
+    """SC201: every ``cond``/``switch`` whose branches issue differing
+    collective sequences, anywhere in the jaxpr."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    findings: list[Finding] = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "cond":
+            branches = eqn.params.get("branches", ())
+            seqs = [collective_sequence(b) for b in branches]
+            if len({tuple(s) for s in seqs}) > 1:
+                desc = ", ".join(
+                    f"branch {i}: {s or ['<none>']}"
+                    for i, s in enumerate(seqs))
+                findings.append(Finding(
+                    "SC201", path, 1, 0,
+                    f"{label}: cond/switch branches issue different "
+                    f"collective sequences ({desc}); devices taking "
+                    "different branches will deadlock — hoist the "
+                    "collective out of the branch"))
+        for sub in _inner_jaxprs(eqn.params):
+            findings.extend(check_branch_collectives(
+                sub, label=label, path=path))
+    return findings
+
+
+def check_callable(fn: Callable, args: tuple, *, label: str,
+                   path: str = "<trace>") -> list[Finding]:
+    """Trace ``fn(*args)`` and run every jaxpr-level rule on the result."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    return check_branch_collectives(closed, label=label, path=path)
+
+
+# -- built-in entry points ----------------------------------------------------
+
+def _pipe_mesh_or_none():
+    import jax
+
+    from tpu_dist.parallel import mesh as mesh_lib
+    from tpu_dist.parallel.axes import PIPE_AXIS
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    return mesh_lib.make_mesh({PIPE_AXIS: 2}, devices=devices[:2])
+
+
+def _shard_mapped(body, mesh, in_specs, out_specs):
+    from tpu_dist.parallel import mesh as mesh_lib
+
+    shard_map = mesh_lib.get_shard_map()
+    kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(body, check_vma=False, **kw)
+    except TypeError:  # pragma: no cover - older jax spells it check_rep
+        return shard_map(body, check_rep=False, **kw)
+
+
+def _trace_gpipe():
+    """GPipe schedule over a 2-stage pipe mesh (parallel/pipeline_parallel)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dist.parallel.axes import PIPE_AXIS
+    from tpu_dist.parallel.pipeline_parallel import gpipe_schedule
+
+    mesh = _pipe_mesh_or_none()
+    if mesh is None:
+        raise RuntimeError("needs >= 2 devices for a pipe mesh")
+    params = jnp.ones(())
+
+    def stage_apply(p, x, key):
+        return x * p
+
+    def body(x_mb):
+        return gpipe_schedule(stage_apply, params, x_mb, num_stages=2,
+                              axis_name=PIPE_AXIS)
+
+    mapped = _shard_mapped(body, mesh, (P(),), P())
+    return jax.make_jaxpr(mapped)(jnp.zeros((4, 2, 3)))
+
+
+def _trace_1f1b():
+    """1F1B schedule over a 2-stage pipe mesh (parallel/pipeline_1f1b)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dist.parallel.pipeline_1f1b import one_f_one_b
+
+    mesh = _pipe_mesh_or_none()
+    if mesh is None:
+        raise RuntimeError("needs >= 2 devices for a pipe mesh")
+    stage_p = jnp.ones(())
+    pre_p = jnp.ones(())
+    post_p = jnp.ones(())
+
+    def stage_apply(p, a):
+        return a * p
+
+    def pre_apply(p, x):
+        return x * p
+
+    def post_loss(p, a, y):
+        return ((a * p - y) ** 2).mean()
+
+    def body(x_mb, y_mb):
+        return one_f_one_b(stage_apply, pre_apply, post_loss, stage_p,
+                           pre_p, post_p, x_mb, y_mb, num_stages=2)
+
+    mapped = _shard_mapped(body, mesh, (P(), P()), (P(), P(), P(), P()))
+    x = jnp.zeros((4, 2))
+    return jax.make_jaxpr(mapped)(x, x)
+
+
+def _trace_train_step():
+    """The trainer's SPMD step on a tiny Dense model (training/trainer.py)."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.models import Dense, Sequential
+    from tpu_dist.training.trainer import Trainer
+
+    model = Sequential([Dense(4)], input_shape=(4,), name="shardcheck_probe")
+    model.compile(optimizer="sgd", loss="mse")
+    trainer = Trainer(model)
+    step = trainer._pure_step()
+    trainer.ensure_variables()
+    state = trainer.train_state()
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 4), np.float32)
+    rng = jax.random.PRNGKey(0)
+    return jax.make_jaxpr(step)(*state, x, y, rng)
+
+
+ENTRY_POINTS = {
+    "pipeline_parallel.gpipe_schedule": _trace_gpipe,
+    "pipeline_1f1b.one_f_one_b": _trace_1f1b,
+    "training.trainer.train_step": _trace_train_step,
+}
+
+
+def run_entry_points(
+        names: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Trace every built-in entry point and collect SC201 findings. An
+    entry point that cannot trace in this environment (too few devices, a
+    moved jax internal) degrades to an SC900 info finding, never a crash —
+    the lint pass's results still stand."""
+    findings: list[Finding] = []
+    for name, tracer in ENTRY_POINTS.items():
+        if names is not None and name not in names:
+            continue
+        try:
+            closed = tracer()
+        except Exception as e:  # noqa: BLE001 - degrade, never crash
+            logger.debug("entry point %s untraceable", name, exc_info=True)
+            findings.append(Finding(
+                "SC900", f"<entry:{name}>", 1, 0,
+                f"entry point {name} could not be traced here "
+                f"({type(e).__name__}: {e}); SC201 skipped for it"))
+            continue
+        findings.extend(check_branch_collectives(
+            closed, label=name, path=f"<entry:{name}>"))
+    return findings

@@ -1,0 +1,75 @@
+"""shardcheck report layer: text/JSON rendering and exit-code policy."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Iterable
+
+from tpu_dist.analysis.rules import RULES, Finding, Severity
+
+
+def sort_findings(findings: Iterable[Finding]) -> list[Finding]:
+    """Stable display order: by path, then line, then rule id."""
+    return sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule_id))
+
+
+def counts_by_severity(findings: Iterable[Finding]) -> dict:
+    counts = {str(s): 0 for s in Severity}
+    for f in findings:
+        counts[str(f.severity)] += 1
+    return counts
+
+
+def exit_code(findings: Iterable[Finding], *,
+              fail_on: str = "error") -> int:
+    """1 when any finding reaches the failure threshold, else 0.
+
+    ``fail_on="never"`` always exits 0 (report-only mode).
+    """
+    if fail_on == "never":
+        return 0
+    threshold = Severity.parse(fail_on)
+    return int(any(f.severity >= threshold for f in findings))
+
+
+def to_json_dict(findings: Iterable[Finding], *, paths=(),
+                 fail_on: str = "error") -> dict:
+    findings = sort_findings(findings)
+    return {
+        "tool": "shardcheck",
+        "checked_paths": list(paths),
+        "counts": counts_by_severity(findings),
+        "findings": [f.to_json() for f in findings],
+        "exit_code": exit_code(findings, fail_on=fail_on),
+    }
+
+
+def render_text(findings: Iterable[Finding], *, paths=(),
+                stream=None) -> None:
+    stream = stream or sys.stdout
+    findings = sort_findings(findings)
+    for f in findings:
+        print(f.render(), file=stream)
+    counts = counts_by_severity(findings)
+    total = sum(counts.values())
+    if total:
+        print(f"shardcheck: {counts['error']} error(s), "
+              f"{counts['warning']} warning(s), {counts['info']} info "
+              f"across {len(list(paths)) or 'the given'} path(s)",
+              file=stream)
+    else:
+        print("shardcheck: no findings", file=stream)
+
+
+def render_rules(stream=None) -> None:
+    """The advertised catalogue, for ``--list-rules``."""
+    stream = stream or sys.stdout
+    for rule in sorted(RULES.values(), key=lambda r: r.id):
+        print(f"{rule.id} [{rule.severity}] {rule.name}\n"
+              f"    {rule.description}", file=stream)
+
+
+def dump_json(payload: dict, stream=None) -> None:
+    json.dump(payload, stream or sys.stdout, indent=2, sort_keys=False)
+    print(file=stream or sys.stdout)

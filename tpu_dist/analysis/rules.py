@@ -1,0 +1,157 @@
+"""shardcheck rule catalogue, findings, and suppression handling.
+
+The rule IDs are the stable public contract: tests assert on them, JSON
+output carries them, and inline suppressions name them
+(``# shardcheck: disable=SC101``). Message text is free to evolve.
+
+Severity model: ``error`` findings are bugs-in-waiting (the CLI exits
+non-zero on them and ``scripts/check.sh`` fails the gate); ``warning`` is
+suspicious-but-possibly-intended; ``info`` is diagnostics (e.g. an entry
+point the jaxpr pass could not trace).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import re
+from typing import Optional
+
+
+class Severity(enum.IntEnum):
+    """Ordered so comparisons read naturally: ERROR > WARNING > INFO."""
+
+    INFO = 10
+    WARNING = 20
+    ERROR = 30
+
+    def __str__(self) -> str:  # JSON/text rendering
+        return self.name.lower()
+
+    @classmethod
+    def parse(cls, value: "Severity | str") -> "Severity":
+        if isinstance(value, cls):
+            return value
+        try:
+            return cls[str(value).upper()]
+        except KeyError:
+            raise ValueError(
+                f"unknown severity {value!r}; valid: "
+                f"{[s.name.lower() for s in cls]}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: Severity
+    description: str
+
+
+#: The advertised catalogue. SC1xx are AST rules (ast_lint.py); SC2xx are
+#: jaxpr-level rules (jaxpr_checks.py).
+RULES = {r.id: r for r in (
+    Rule(
+        "SC101", "unknown-collective-axis", Severity.ERROR,
+        "A collective (psum/pmean/all_gather/ppermute/...) names a mesh "
+        "axis that is neither canonical (tpu_dist.parallel.axes) nor "
+        "declared anywhere in the file (mesh/axis_shapes literal, *_AXIS "
+        "constant, axis_name= parameter default). A wrong axis name "
+        "raises at trace time at best and silently reduces over the "
+        "wrong group at worst."),
+    Rule(
+        "SC102", "partitionspec-rank-mismatch", Severity.ERROR,
+        "A PartitionSpec used to place an array has more entries than "
+        "the array has dimensions. XLA rejects the placement at run "
+        "time; catching it statically saves the trace/compile cycle."),
+    Rule(
+        "SC103", "host-side-effect-in-jit", Severity.ERROR,
+        "A host side effect (print, time.time, stdlib random, input) "
+        "inside a jitted function. These run once at trace time, not "
+        "per step — prints go silent, clocks freeze, and Python "
+        "randomness is baked into the compiled program as a constant."),
+    Rule(
+        "SC104", "donated-buffer-reuse", Severity.ERROR,
+        "An argument donated via jit(donate_argnums=...) is read after "
+        "the donating call. The buffer has been handed to XLA for "
+        "aliasing; reusing it raises on real hardware and is "
+        "silently-wrong on backends that skip donation."),
+    Rule(
+        "SC201", "collective-order-divergence", Severity.ERROR,
+        "Branches of a lax.cond/switch issue different collective "
+        "sequences. When the predicate is device-varying (the usual "
+        "reason to branch in SPMD code), devices taking different "
+        "branches launch mismatched collectives and the program "
+        "deadlocks — the bug class TF's runtime ordered away and XLA "
+        "will not catch for you."),
+    Rule(
+        "SC900", "entry-point-untraceable", Severity.INFO,
+        "A registered jaxpr-check entry point could not be traced in "
+        "this environment; its collective-order check was skipped."),
+)}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    @property
+    def rule(self) -> Rule:
+        return RULES[self.rule_id]
+
+    @property
+    def severity(self) -> Severity:
+        return self.rule.severity
+
+    def to_json(self) -> dict:
+        return {
+            "rule_id": self.rule_id,
+            "name": self.rule.name,
+            "severity": str(self.severity),
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule_id} {self.severity}] {self.message}")
+
+
+#: ``# shardcheck: disable=SC101`` or ``disable=SC101,SC103`` or
+#: ``disable=all``; anything after the rule list (a justification) is free
+#: text. Matches anywhere in the physical line so it can trail code.
+_SUPPRESS_RE = re.compile(
+    r"#\s*shardcheck:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s+--?\s|$|#)")
+
+
+def suppressions_for_line(source_line: str) -> Optional[set]:
+    """Rule IDs suppressed on this physical line, or None when no
+    suppression comment is present. ``{"all"}`` suppresses every rule."""
+    m = _SUPPRESS_RE.search(source_line)
+    if not m:
+        return None
+    ids = {part.strip() for part in m.group(1).split(",") if part.strip()}
+    return {i if i.lower() != "all" else "all" for i in ids}
+
+
+def apply_suppressions(findings, source_by_path) -> list:
+    """Drop findings whose source line carries a matching suppression.
+
+    ``source_by_path`` maps path -> list of source lines (1-indexed via
+    ``line - 1``). Findings for paths not in the map pass through.
+    """
+    kept = []
+    for f in findings:
+        lines = source_by_path.get(f.path)
+        if lines is not None and 1 <= f.line <= len(lines):
+            sup = suppressions_for_line(lines[f.line - 1])
+            if sup is not None and ("all" in sup or f.rule_id in sup):
+                continue
+        kept.append(f)
+    return kept

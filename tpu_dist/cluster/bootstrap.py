@@ -54,7 +54,17 @@ def initialize(config: ClusterConfig | None = None, *,
        (1 worker behaves like single-host MirroredStrategy).
     """
     global _INITIALIZED, _CONFIG
+    import inspect
+
     import jax
+
+    def _dist_init(**kwargs):
+        # jax < 0.5 has no heartbeat_timeout_seconds (or other newer)
+        # kwargs on jax.distributed.initialize; drop what this version
+        # doesn't accept rather than failing bring-up.
+        sig = inspect.signature(jax.distributed.initialize)
+        jax.distributed.initialize(**{
+            k: v for k, v in kwargs.items() if k in sig.parameters})
 
     with _STATE_LOCK:
         if _INITIALIZED:
@@ -70,7 +80,7 @@ def initialize(config: ClusterConfig | None = None, *,
 
         if coordinator_address is not None:
             # Explicit JAX-style bring-up, bypassing TF_CONFIG.
-            jax.distributed.initialize(
+            _dist_init(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
@@ -87,7 +97,7 @@ def initialize(config: ClusterConfig | None = None, *,
             # The declared addresses are ours to bind (no TF gRPC servers exist
             # in this framework); process 0's entry doubles as the coordination
             # service endpoint.
-            jax.distributed.initialize(
+            _dist_init(
                 coordinator_address=config.coordinator_address,
                 num_processes=config.num_processes,
                 process_id=config.process_id,
@@ -96,7 +106,7 @@ def initialize(config: ClusterConfig | None = None, *,
             _log_bringup()
         elif config is None and _tpu_pod_env_present():
             logger.info("tpu_dist: no TF_CONFIG; using TPU pod autodetection")
-            jax.distributed.initialize(
+            _dist_init(
                 heartbeat_timeout_seconds=max(1, round(hb)))
             _log_bringup()
         else:

@@ -1,8 +1,14 @@
 """Parallelism layer: device mesh, collectives, distribution strategies."""
 
-from tpu_dist.parallel.mesh import (
+from tpu_dist.parallel.axes import (
+    CANONICAL_AXES,
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+)
+from tpu_dist.parallel.mesh import (
     batch_sharded,
     make_mesh,
     replicate,
@@ -53,6 +59,7 @@ from tpu_dist.parallel.strategy import (
 )
 
 __all__ = [
+    "CANONICAL_AXES",
     "DATA_AXIS",
     "MODEL_AXIS",
     "batch_sharded",

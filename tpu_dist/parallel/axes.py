@@ -1,0 +1,49 @@
+"""Canonical mesh axis names — the single source of truth.
+
+Every parallelism family in this repo communicates over a NAMED mesh axis,
+and the name is part of the user-visible contract: ``PartitionSpec('data')``
+on a batch, ``psum(grads, 'data')`` in a custom loop, ``axis_shapes={'data':
+2, 'model': 4}`` on a strategy. A typo'd axis name compiles fine on the
+Python side and fails (or worse, silently mis-shards) only at trace time —
+which is why the static checker (:mod:`tpu_dist.analysis`) validates every
+collective's axis argument against this registry.
+
+This module is intentionally dependency-free (no jax import): the analysis
+CLI reads it without initializing a backend, and every ``*_AXIS`` constant
+elsewhere in the package is a re-export of these definitions.
+"""
+
+from __future__ import annotations
+
+#: Data-parallel axis: batches shard over it, gradients all-reduce over it
+#: (the reference's MultiWorkerMirroredStrategy semantics).
+DATA_AXIS = "data"
+
+#: Tensor-parallel axis: Megatron-style column/row-parallel weight shards
+#: (parallel/tensor.py).
+MODEL_AXIS = "model"
+
+#: Sequence-parallel axis: ring attention rotates K/V shards over it
+#: (parallel/sequence.py).
+SEQ_AXIS = "seq"
+
+#: Pipeline-parallel axis: stage-stacked parameters shard one-stage-per-
+#: device; the microbatch schedule ppermutes activations over it
+#: (parallel/pipeline_parallel.py, parallel/pipeline_1f1b.py).
+PIPE_AXIS = "pipe"
+
+#: Expert-parallel axis: MoE expert bundles shard over it; tokens
+#: all_to_all to their experts and back (parallel/expert.py).
+EXPERT_AXIS = "expert"
+
+#: Every axis name the framework itself declares. The analysis pass treats
+#: these, plus any axis a file declares locally (mesh literals, ``*_AXIS``
+#: module constants, ``axis_name=`` parameter defaults), as valid collective
+#: targets.
+CANONICAL_AXES = frozenset(
+    (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS))
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
+    "CANONICAL_AXES",
+]

@@ -55,7 +55,7 @@ from tpu_dist.ops import initializers
 logger = logging.getLogger("tpu_dist.expert")
 
 #: Mesh axis name the expert dimension shards over.
-EXPERT_AXIS = "expert"
+from tpu_dist.parallel.axes import EXPERT_AXIS  # noqa: F401 - canonical home
 
 
 def _route(gates, top_k: int, capacity: int):

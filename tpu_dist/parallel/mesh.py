@@ -26,8 +26,7 @@ from typing import Mapping, Sequence
 
 import numpy as np
 
-DATA_AXIS = "data"
-MODEL_AXIS = "model"
+from tpu_dist.parallel.axes import DATA_AXIS, MODEL_AXIS  # noqa: F401 - canonical home
 
 
 def get_shard_map():

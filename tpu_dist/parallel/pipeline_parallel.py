@@ -50,8 +50,9 @@ from tpu_dist.models.layers import Layer
 
 logger = logging.getLogger("tpu_dist.pipeline")
 
-#: Mesh axis name the stage dimension shards over.
-PIPE_AXIS = "pipe"
+#: Mesh axis name the stage dimension shards over (canonical home:
+#: tpu_dist/parallel/axes.py).
+from tpu_dist.parallel.axes import PIPE_AXIS  # noqa: E402,F401
 
 
 def _has_array_leaves(tree) -> bool:

@@ -38,7 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-SEQ_AXIS = "seq"
+from tpu_dist.parallel.axes import SEQ_AXIS  # noqa: F401 - canonical home
 
 
 def _online_merge(m, l, acc, scores, v):
@@ -65,7 +65,13 @@ def _mark_varying(x, axes):
     try:
         return jax.lax.pcast(x, axes, to="varying")
     except (AttributeError, TypeError):  # pragma: no cover - older jax
+        pass
+    try:
         return jax.lax.pvary(x, axes)
+    except AttributeError:
+        # jax without varying-type annotations (< 0.5, e.g. 0.4.37): the
+        # rep checker is disabled by the shard_map shim, so no mark needed.
+        return x
 
 
 #: Within-shard K/V chunking threshold/size: shards longer than the
