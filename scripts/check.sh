@@ -1,17 +1,33 @@
 #!/usr/bin/env bash
-# Repo gate: shardcheck static analysis, then the tier-1 test suite.
+# Repo gate: shardcheck static analysis, the resilience smoke chaos run,
+# then the tier-1 test suite.
 #
 # Usage: scripts/check.sh
 #
 # Step 1 runs `python -m tpu_dist.analysis` over the package and fails on
 # any error-severity finding (the dogfooded self-check — see README.md
-# "Static analysis"). Step 2 is the tier-1 pytest command from ROADMAP.md.
+# "Static analysis"). Step 2 is the supervised kill/restart/resume demo
+# (README.md "Fault tolerance & chaos testing"). Step 3 is the tier-1
+# pytest command from ROADMAP.md.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "== shardcheck: static sharding/collective analysis =="
 JAX_PLATFORMS=cpu python -m tpu_dist.analysis tpu_dist/ --fail-on error \
   || { echo "check.sh: shardcheck found error-severity findings" >&2; exit 1; }
+
+echo "== resilience-smoke: supervised kill/restart/resume chaos run =="
+# The acceptance demo from README.md "Fault tolerance & chaos testing":
+# kill the demo worker at global step 5, supervisor restarts it, it resumes
+# from the last complete checkpoint, and the report must show loss parity
+# with the uninterrupted baseline (exit 0 only when the fault actually
+# fired AND recovery converged to the same place).
+smoke_dir=$(mktemp -d /tmp/tpu-dist-smoke.XXXXXX)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
+  --plan kill-worker@step5 --workdir "$smoke_dir" >/dev/null \
+  || { echo "check.sh: resilience smoke chaos run failed (see $smoke_dir)" >&2
+       exit 1; }
+rm -rf "$smoke_dir"
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

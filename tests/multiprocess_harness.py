@@ -51,6 +51,13 @@ def free_ports(n: int) -> list[int]:
     return ports
 
 
+#: jaxlib's CPU collective backend gap (raised from sync_global_devices /
+#: cross-process collectives on some jax builds). A worker dying with this
+#: is an environment limitation, not a regression in the code under test.
+BACKEND_LIMIT_MARKER = (
+    "Multiprocess computations aren't implemented on the CPU backend")
+
+
 @dataclasses.dataclass
 class WorkerResult:
     index: int
@@ -106,6 +113,16 @@ def run_workers(body: str, num_workers: int = 2, *, timeout: float = 300.0,
             if line.startswith("RESULT:"):
                 result = json.loads(line[len("RESULT:"):])
         results.append(WorkerResult(i, p.returncode, result, out, err))
+
+    failed = [r for r in results if r.returncode != 0]
+    if failed and any(BACKEND_LIMIT_MARKER in r.stderr for r in failed):
+        import pytest
+
+        pytest.skip(
+            "this jax build cannot run cross-process collectives on the "
+            f"CPU backend ({BACKEND_LIMIT_MARKER!r}); multiprocess "
+            "semantics need a TPU/GPU backend or a collectives-capable "
+            "CPU jaxlib")
     return results
 
 

@@ -1,0 +1,289 @@
+"""tpu_dist.resilience tests: fault-plan parsing determinism, backoff math,
+checkpoint-validation fallback under corruption, the event log, and the
+single-host chaos loop (tier-1 safe: in-process faults only corrupt staged
+checkpoint bytes — nothing kills the test process itself).
+
+The kill/restart path is covered end to end by the CLI test at the bottom
+(subprocess supervision) and, across real workers, by the slow-marked
+Supervisor test in test_multiprocess.py.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.resilience import (EXIT_FAULT_KILL, EXIT_PEER_UNAVAILABLE,
+                                 FAULT_PLAN_ENV, EventLog, FaultPlan,
+                                 FaultSpec, describe, read_events)
+from tpu_dist.resilience.events import ATTEMPT_ENV, EVENT_LOG_ENV
+from tpu_dist.training import checkpoint
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+class TestFaultPlanParsing:
+    def test_compact_kill_defaults(self):
+        plan = FaultPlan.parse("kill-worker@step5")
+        (f,) = plan.faults
+        assert (f.kind, f.step, f.epoch) == ("kill", 5, None)
+        assert (f.rank, f.attempt, f.count) == (0, 0, 1)
+        assert f.exit_code == EXIT_FAULT_KILL
+
+    def test_compact_modifiers(self):
+        plan = FaultPlan.parse(
+            "kill@epoch1:rank1:attempt2, ckpt-fail@epoch0:truncate:x2,"
+            "delay-collective@step3:0.5s, slow-input@step2:0.25s:x4,"
+            "hang-collective@step4:always")
+        kill, ckpt, delay, slow, hang = plan.faults
+        assert (kill.epoch, kill.rank, kill.attempt) == (1, 1, 2)
+        assert (ckpt.kind, ckpt.mode, ckpt.count) == (
+            "checkpoint_fail", "truncate", 2)
+        assert (delay.kind, delay.seconds) == ("delay_collective", 0.5)
+        assert (slow.seconds, slow.count) == (0.25, 4)
+        assert hang.attempt is None  # fires on every restart attempt
+
+    def test_json_roundtrip_is_identity(self):
+        plan = FaultPlan.parse("kill@step5:rank1, ckpt-fail@epoch2:truncate")
+        assert FaultPlan.parse(plan.dumps()) == plan
+
+    def test_at_path_loads_json_file(self, tmp_path):
+        plan = FaultPlan.parse("slow-input@step1:2s")
+        p = tmp_path / "plan.json"
+        p.write_text(plan.dumps())
+        assert FaultPlan.parse(f"@{p}") == plan
+
+    @pytest.mark.parametrize("bad", [
+        "explode@step1",            # unknown kind
+        "kill@tuesday",             # bad target
+        "kill",                     # no target at all
+        "kill@step1:wat",           # unknown modifier
+        "ckpt-fail@epoch0:gone",    # invalid mode
+    ])
+    def test_bad_compact_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            FaultPlan.parse(bad)
+
+    def test_unknown_json_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown FaultSpec field"):
+            FaultPlan.from_json(
+                {"faults": [{"kind": "kill", "step": 1, "stpe": 2}]})
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv(FAULT_PLAN_ENV, raising=False)
+        assert FaultPlan.from_env() is None
+        monkeypatch.setenv(FAULT_PLAN_ENV, "kill@step3")
+        assert FaultPlan.from_env() == FaultPlan.parse("kill@step3")
+        # A plan that does not parse is a hard error, never a silent no-op.
+        monkeypatch.setenv(FAULT_PLAN_ENV, "oops@nowhere")
+        with pytest.raises(ValueError):
+            FaultPlan.from_env()
+
+    def test_describe_covers_every_fault(self):
+        plan = FaultPlan.parse("kill@step5:rank1, ckpt-fail@epoch0:always")
+        lines = describe(plan)
+        assert len(lines) == len(plan.faults)
+        assert "rank 1" in lines[0] and "every attempt" in lines[1]
+
+
+class TestFaultTargeting:
+    def test_rank_and_attempt_gating(self):
+        plan = FaultPlan.parse("kill@step5:rank1, slow-input@step0:always")
+        assert [f.kind for f in plan.for_process(1, 0)] == ["kill"]
+        # Default attempt=0: the restart does not re-kill itself...
+        assert plan.for_process(1, 1) == []
+        # ...rank gating keeps other workers clean, and :always faults
+        # (rank 0 by default) re-arm on every attempt.
+        assert [f.kind for f in plan.for_process(0, 0)] == ["slow_input"]
+        assert [f.kind for f in plan.for_process(0, 5)] == ["slow_input"]
+
+    def test_due_at_step_is_geq(self):
+        # >= so steps_per_execution > 1 cannot jump past the target.
+        f = FaultSpec(kind="kill", step=5)
+        assert not f.due_at_step(4)
+        assert f.due_at_step(5) and f.due_at_step(7)
+
+    def test_injector_from_env_filters_to_this_process(self, monkeypatch):
+        from tpu_dist.resilience.injector import maybe_injector_from_env
+
+        monkeypatch.setenv(FAULT_PLAN_ENV, "slow-input@step1:rank3")
+        assert maybe_injector_from_env(
+            steps_per_epoch=4, rank=0, attempt=0) is None
+        inj = maybe_injector_from_env(steps_per_epoch=4, rank=3, attempt=0)
+        assert inj is not None and len(inj.faults) == 1
+        monkeypatch.delenv(FAULT_PLAN_ENV)
+        assert maybe_injector_from_env(
+            steps_per_epoch=4, rank=0, attempt=0) is None
+
+
+class TestBackoffAndExitCodes:
+    def test_backoff_doubles_and_caps(self):
+        from tpu_dist.resilience.supervisor import BackoffPolicy
+
+        b = BackoffPolicy(initial_s=0.5, multiplier=2.0, max_s=3.0)
+        assert [b.delay(n) for n in range(4)] == [0.5, 1.0, 2.0, 3.0]
+        with pytest.raises(ValueError):
+            b.delay(-1)
+
+    def test_classify_exit(self):
+        from tpu_dist.resilience.supervisor import classify_exit
+
+        assert classify_exit(0) == "clean"
+        assert classify_exit(EXIT_FAULT_KILL) == "fault_kill"
+        assert classify_exit(EXIT_PEER_UNAVAILABLE) == "peer_unavailable"
+        assert classify_exit(-9) == "signal_9"
+        assert classify_exit(1) == "crash"
+
+
+class TestEventLog:
+    def test_append_and_read(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        log = EventLog(path, role="worker")
+        log.append("fault_armed", kind="kill")
+        log.append("fault_fired", kind="kill", at="step 5")
+        assert [e["event"] for e in read_events(path)] == [
+            "fault_armed", "fault_fired"]
+        assert read_events(path, "fault_fired")[0]["at"] == "step 5"
+
+    def test_partial_trailing_line_skipped(self, tmp_path):
+        path = tmp_path / "events.jsonl"
+        EventLog(path, role="worker").append("restart", attempt=1)
+        with open(path, "a") as fh:
+            fh.write('{"event": "worker_ex')  # writer died mid-record
+        assert [e["event"] for e in read_events(path)] == ["restart"]
+
+    def test_current_attempt_from_env(self, monkeypatch):
+        from tpu_dist.resilience import current_attempt
+
+        monkeypatch.delenv(ATTEMPT_ENV, raising=False)
+        assert current_attempt() == 0
+        monkeypatch.setenv(ATTEMPT_ENV, "2")
+        assert current_attempt() == 2
+
+
+class TestCheckpointValidation:
+    def _fit_with_ckpt(self, ckdir, *, epochs):
+        model = td.models.Sequential(
+            [td.models.Flatten(), td.models.Dense(4)], input_shape=(2, 2, 1))
+        model.compile(loss="mse", optimizer="sgd")
+        rng = np.random.default_rng(0)
+        x = rng.random((8, 2, 2, 1)).astype(np.float32)
+        y = rng.random((8, 4)).astype(np.float32)
+        ds = td.data.Dataset.from_tensor_slices((x, y)).batch(4)
+        hist = model.fit(ds, epochs=epochs, steps_per_epoch=2, verbose=0,
+                         checkpoint_dir=str(ckdir))
+        return hist.history["loss"]
+
+    def test_truncated_npz_rejected_and_fallback(self, eight_devices,
+                                                 tmp_path):
+        ckdir = tmp_path / "ckpt"
+        self._fit_with_ckpt(ckdir, epochs=2)
+        assert checkpoint.latest_complete_step(ckdir) == 1
+        # Truncate the newest step's arrays: the zip central directory lives
+        # at the end, so the file no longer opens.
+        npz = checkpoint._step_dir(ckdir, 1) / "arrays.npz"
+        npz.write_bytes(npz.read_bytes()[:npz.stat().st_size // 2])
+        assert checkpoint.validate_step_dir(
+            checkpoint._step_dir(ckdir, 1)) is not None
+        assert not checkpoint.is_complete(ckdir, 1)
+        assert checkpoint.latest_complete_step(ckdir) == 0
+        # Explicitly restoring the bad step refuses loudly.
+        model = td.models.Sequential(
+            [td.models.Flatten(), td.models.Dense(4)], input_shape=(2, 2, 1))
+        model.compile(loss="mse", optimizer="sgd")
+        with pytest.raises(ValueError, match="failed validation"):
+            checkpoint.restore_model(ckdir, model, step=1)
+
+    def test_missing_manifest_rejected(self, eight_devices, tmp_path):
+        ckdir = tmp_path / "ckpt"
+        self._fit_with_ckpt(ckdir, epochs=1)
+        (checkpoint._step_dir(ckdir, 0) / "manifest.json").unlink()
+        assert checkpoint.latest_complete_step(ckdir) is None
+
+
+class TestInProcessChaos:
+    """Tier-1-safe chaos: the injected fault corrupts checkpoint BYTES, not
+    the test process. A truncate fault poisons the newest checkpoint; the
+    next run must fall back to the older complete one and still reproduce
+    the uninterrupted run's losses exactly (epoch-keyed RNG + one-pass
+    dataset cardinality make resumed epochs bit-identical)."""
+
+    def _fit(self, ckdir, *, epochs):
+        model = td.models.build_and_compile_cnn_model(learning_rate=0.01)
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, size=(32,)).astype(np.int32)
+        ds = td.data.Dataset.from_tensor_slices((x, y)).batch(16)
+        hist = model.fit(ds, epochs=epochs, steps_per_epoch=2, verbose=0,
+                         checkpoint_dir=str(ckdir))
+        return hist.history["loss"]
+
+    def test_truncate_fault_then_resume_matches_baseline(
+            self, eight_devices, tmp_path, monkeypatch):
+        monkeypatch.delenv(FAULT_PLAN_ENV, raising=False)
+        baseline = self._fit(tmp_path / "baseline", epochs=3)
+
+        event_path = tmp_path / "events.jsonl"
+        monkeypatch.setenv(EVENT_LOG_ENV, str(event_path))
+        monkeypatch.setenv(FAULT_PLAN_ENV, "ckpt-fail@epoch1:truncate")
+        ckdir = tmp_path / "chaos"
+        chaos = self._fit(ckdir, epochs=2)
+        assert chaos == baseline[:2]  # same trajectory up to the fault
+        fired = read_events(event_path, "fault_fired")
+        assert [e["kind"] for e in fired] == ["checkpoint_fail"]
+        # The corrupted step 1 is visible but incomplete; step 0 survives.
+        assert checkpoint.latest_step(ckdir) == 1
+        assert checkpoint.latest_complete_step(ckdir) == 0
+
+        monkeypatch.setenv(FAULT_PLAN_ENV, "")
+        resumed = self._fit(ckdir, epochs=3)  # restores 0, runs epochs 1-2
+        assert len(resumed) == 2
+        np.testing.assert_allclose(resumed, baseline[1:], atol=1e-6)
+        resumes = read_events(event_path, "checkpoint_resume")
+        assert resumes and resumes[-1]["step"] == 0
+
+
+class TestChaosCli:
+    def test_parse_result_line_takes_last(self):
+        from tpu_dist.resilience.cli import parse_result_line
+
+        text = ("noise\nRESULT:{\"final_loss\": 1.0}\n"
+                "more\nRESULT:{\"final_loss\": 2.0}\nRESULT:{broken\n")
+        assert parse_result_line(text) == {"final_loss": 2.0}
+        assert parse_result_line("no results here") is None
+
+    def test_empty_plan_is_usage_error(self, capsys):
+        from tpu_dist.resilience.cli import main
+
+        assert main(["--plan", "  "]) == 2
+
+    def test_kill_worker_chaos_run_end_to_end(self, tmp_path):
+        """The acceptance demo: kill at global step 5, supervised restart,
+        resume from the last complete checkpoint, loss parity vs the
+        uninterrupted baseline."""
+        report_path = tmp_path / "report.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.resilience",
+             "--plan", "kill-worker@step5",
+             "--workdir", str(tmp_path / "chaos"),
+             "--report", str(report_path)],
+            capture_output=True, text=True, timeout=300,
+            cwd=str(REPO_ROOT), env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(report_path.read_text())
+        assert report["ok"] and report["success"]
+        assert report["restarts"] >= 1
+        assert report["exit_codes"][0] == [EXIT_FAULT_KILL]
+        assert [f["kind"] for f in report["faults_fired"]] == ["kill"]
+        assert report["parity_ok"]
+        assert abs(report["loss_delta"]) <= 1e-5
+        kinds = [e["event"] for e in read_events(
+            tmp_path / "chaos" / "events.jsonl")]
+        assert "restart" in kinds and "recovered" in kinds
+        assert "checkpoint_resume" in kinds

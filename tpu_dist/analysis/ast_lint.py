@@ -15,6 +15,10 @@ mistake classes that compile fine and fail only on the machine:
   once at trace time, not per step.
 * **SC104** — reads of a buffer after it was donated to a
   ``jit(donate_argnums=...)`` call in the same scope.
+* **SC105** — broad ``except Exception`` / bare ``except`` handlers around
+  liveness-raising calls (``raise_if_failed``, ``barrier``, chief
+  broadcasts, host reductions) that swallow ``PeerUnavailableError``
+  without a dedicated handler or re-raise.
 
 The pass is deliberately conservative: an axis name or array rank it
 cannot resolve statically is skipped, never guessed. Findings carry rule
@@ -60,6 +64,17 @@ _ARRAY_CTOR_SHAPE_POS = {
 
 _TIME_EFFECTS = {"time.time", "time.perf_counter", "time.monotonic",
                  "time.time_ns", "time.perf_counter_ns"}
+
+#: Call tails whose failure semantics include PeerUnavailableError — the
+#: liveness verdict surface (cluster/liveness.py) and the host-level
+#: rendezvous points that a dead peer turns into raises/hangs. SC105 only
+#: fires on broad handlers around THESE calls; an opaque `fn()` is skipped
+#: (conservative, like every other rule here).
+_LIVENESS_RAISING = {"raise_if_failed", "check_peer_health", "barrier",
+                     "broadcast_from_chief", "host_all_reduce_sum"}
+
+#: Exception names that make a handler "broad" for SC105.
+_BROAD_EXCEPTIONS = {"Exception", "BaseException"}
 
 
 def _collect_aliases(tree: ast.Module) -> dict:
@@ -453,6 +468,55 @@ class _FileLint(ast.NodeVisitor):
                     # x = g(x): rebound to the returned value — safe.
                     del donated[name]
 
+    # -- SC105 ----------------------------------------------------------------
+
+    def _handler_names(self, handler: ast.ExceptHandler) -> set:
+        """Tail names of the exception types a handler catches ({} for a
+        bare ``except:``)."""
+        t = handler.type
+        if t is None:
+            return set()
+        nodes = t.elts if isinstance(t, ast.Tuple) else (t,)
+        names = set()
+        for node in nodes:
+            dotted = _dotted(node, self.aliases)
+            if dotted:
+                names.add(dotted.rsplit(".", 1)[-1])
+        return names
+
+    def _check_swallowed_liveness(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            raising = [
+                self._call_tail(c)
+                for stmt in node.body for c in ast.walk(stmt)
+                if isinstance(c, ast.Call)
+                and self._call_tail(c) in _LIVENESS_RAISING]
+            if not raising:
+                continue
+            liveness_handled = False
+            for handler in node.handlers:
+                names = self._handler_names(handler)
+                if "PeerUnavailableError" in names:
+                    liveness_handled = True
+                    continue
+                broad = handler.type is None or (names & _BROAD_EXCEPTIONS)
+                if not broad or liveness_handled:
+                    continue
+                if any(isinstance(n, ast.Raise)
+                       for s in handler.body for n in ast.walk(s)):
+                    continue  # re-raises: the signal still propagates
+                caught = ("bare except" if handler.type is None
+                          else f"except {sorted(names)[0]}")
+                self._flag(
+                    "SC105", handler,
+                    f"{caught} around {sorted(set(raising))[0]}() swallows "
+                    "PeerUnavailableError; a dead-peer verdict must "
+                    "propagate so supervision can restart the worker — "
+                    "catch PeerUnavailableError separately first, or "
+                    "re-raise")
+
     # -- driver ---------------------------------------------------------------
 
     def run(self) -> list[Finding]:
@@ -461,6 +525,7 @@ class _FileLint(ast.NodeVisitor):
         self._check_spec_ranks()
         self._check_jit_side_effects()
         self._check_donated_reuse()
+        self._check_swallowed_liveness()
         return self.findings
 
 

@@ -207,10 +207,32 @@ def _trace_train_step():
     return jax.make_jaxpr(step)(*state, x, y, rng)
 
 
+def _trace_resilience_demo_step():
+    """The supervised/resumable trainer step as the resilience demo runs it
+    (resilience/entrypoints.py: the reference CNN under fit(checkpoint_dir=),
+    the program every chaos run restarts and resumes)."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.models.cnn import build_and_compile_cnn_model
+    from tpu_dist.training.trainer import Trainer
+
+    model = build_and_compile_cnn_model(learning_rate=0.01)
+    trainer = Trainer(model)
+    step = trainer._pure_step()
+    trainer.ensure_variables()
+    state = trainer.train_state()
+    x = np.zeros((8, 28, 28, 1), np.float32)
+    y = np.zeros((8,), np.int32)
+    rng = jax.random.PRNGKey(0)
+    return jax.make_jaxpr(step)(*state, x, y, rng)
+
+
 ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
     "training.trainer.train_step": _trace_train_step,
+    "resilience.entrypoints.demo_train_step": _trace_resilience_demo_step,
 }
 
 

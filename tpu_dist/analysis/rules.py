@@ -77,6 +77,14 @@ RULES = {r.id: r for r in (
         "aliasing; reusing it raises on real hardware and is "
         "silently-wrong on backends that skip donation."),
     Rule(
+        "SC105", "swallowed-liveness-error", Severity.ERROR,
+        "A bare `except Exception` (or `except:`) around a call that can "
+        "raise PeerUnavailableError (liveness verdicts, barriers, chief "
+        "broadcasts, host reductions) swallows the dead-peer signal. A "
+        "supervised run recovers from that error by restarting the "
+        "worker; a handler that eats it leaves the job half-alive. Catch "
+        "PeerUnavailableError explicitly first, or re-raise."),
+    Rule(
         "SC201", "collective-order-divergence", Severity.ERROR,
         "Branches of a lax.cond/switch issue different collective "
         "sequences. When the predicate is device-varying (the usual "

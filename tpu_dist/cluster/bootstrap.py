@@ -199,6 +199,12 @@ def barrier(name: str = "tpu_dist_barrier") -> None:
     1043-1066, SURVEY.md §5.3).
     """
     import jax
+
+    from tpu_dist.parallel.collectives import fire_fault_hook
+
+    # Chaos seam first: a single-process run has no peers to rendezvous
+    # with, but an injected barrier stall must still be injectable there.
+    fire_fault_hook("barrier")
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils

@@ -40,6 +40,43 @@ def set_collective_logging(enabled: bool) -> None:
     _LOG_COLLECTIVES = bool(enabled)
 
 
+#: Fault-injection seam (tpu_dist.resilience): when installed, every wrapper
+#: in this module (and bootstrap.barrier) reports its op name here BEFORE
+#: doing the real work, so a chaos harness can delay or wedge host-level
+#: collectives without code edits. None in production — one pointer check.
+_FAULT_HOOK = None
+
+
+def install_fault_hook(hook):
+    """Install (or, with None, remove) the collective fault hook.
+
+    ``hook(op_name)`` is called eagerly before each host-level collective;
+    it may sleep (delay/hang injection) or raise (failure injection).
+    Returns the previously installed hook so callers can restore it.
+    """
+    global _FAULT_HOOK
+    prev = _FAULT_HOOK
+    _FAULT_HOOK = hook
+    return prev
+
+
+def fire_fault_hook(op: str) -> None:
+    """Invoke the installed fault hook, but only from eager (host) context:
+    collectives traced into a jitted program call these wrappers once at
+    trace time, where a sleep would stall compilation, not the step."""
+    hook = _FAULT_HOOK
+    if hook is None:
+        return
+    try:
+        from jax.core import trace_state_clean
+
+        if not trace_state_clean():
+            return
+    except ImportError:  # pragma: no cover - older/newer jax layout
+        pass
+    hook(op)
+
+
 class CollectiveCommunication(enum.Enum):
     """Communication-implementation hint.
 
@@ -111,6 +148,7 @@ def all_reduce(tree: Any, axis: str, op: ReduceOp | str = ReduceOp.MEAN) -> Any:
     schedules the emitted CrossReplicaSum ops; no manual packing needed.
     """
     op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
+    fire_fault_hook("all_reduce")
     _log_tree(f"all_reduce[{op.value}]", tree, axis)
     if op is ReduceOp.SUM:
         return jax.lax.psum(tree, axis)
@@ -125,6 +163,7 @@ def all_reduce(tree: Any, axis: str, op: ReduceOp | str = ReduceOp.MEAN) -> Any:
 
 def all_gather(x: Any, axis: str, *, tiled: bool = False) -> Any:
     """Gather values across a mesh axis (per-replica -> global view)."""
+    fire_fault_hook("all_gather")
     _log_tree("all_gather", x, axis)
     return jax.lax.all_gather(x, axis, tiled=tiled)
 
@@ -136,6 +175,7 @@ def host_all_reduce_sum(x) -> Any:
     DCN fabric); the analog of the reference's host-side PerReplica metric
     reduction (keras trainer reduce_per_replica, SURVEY.md D15).
     """
+    fire_fault_hook("host_all_reduce_sum")
     if jax.process_count() == 1:
         return x
     from jax.experimental import multihost_utils
@@ -146,6 +186,7 @@ def host_all_reduce_sum(x) -> Any:
 def broadcast_from_chief(tree: Any) -> Any:
     """Broadcast process 0's pytree to all processes (host-level, D4 init
     broadcast / checkpoint-restore fan-out)."""
+    fire_fault_hook("broadcast_from_chief")
     if jax.process_count() == 1:
         return tree
     from jax.experimental import multihost_utils

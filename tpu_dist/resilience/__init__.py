@@ -1,0 +1,49 @@
+"""tpu_dist.resilience — fault injection + supervised restart/resume.
+
+Two halves that test each other: a deterministic fault injector
+(:mod:`~tpu_dist.resilience.faults`, :mod:`~tpu_dist.resilience.injector`)
+that breaks a training run at a chosen (rank, attempt, step) coordinate,
+and a supervision runtime (:mod:`~tpu_dist.resilience.supervisor`,
+:mod:`~tpu_dist.resilience.entrypoints`) that detects the break, restarts
+the gang, and resumes from the newest complete checkpoint. ``python -m
+tpu_dist.resilience`` (:mod:`~tpu_dist.resilience.cli`) runs both against a
+workload and reports whether recovery reproduced the uninterrupted run.
+
+Only the dependency-light halves (faults, events) import eagerly; the
+injector and supervisor pull in jax/training lazily via ``__getattr__`` so
+``from tpu_dist.resilience import events`` stays cheap inside the trainer.
+"""
+
+from tpu_dist.resilience.events import (ATTEMPT_ENV, EVENT_LOG_ENV, EventLog,
+                                        current_attempt, maybe_log,
+                                        read_events)
+from tpu_dist.resilience.faults import (EXIT_FAULT_KILL,
+                                        EXIT_PEER_UNAVAILABLE,
+                                        FAULT_PLAN_ENV, FaultPlan, FaultSpec,
+                                        describe)
+
+__all__ = [
+    "ATTEMPT_ENV", "EVENT_LOG_ENV", "EventLog", "current_attempt",
+    "maybe_log", "read_events",
+    "EXIT_FAULT_KILL", "EXIT_PEER_UNAVAILABLE", "FAULT_PLAN_ENV",
+    "FaultPlan", "FaultSpec", "describe",
+    "FaultInjector", "maybe_injector_from_env",
+    "BackoffPolicy", "Supervisor", "SupervisorReport",
+]
+
+_LAZY = {
+    "FaultInjector": "tpu_dist.resilience.injector",
+    "maybe_injector_from_env": "tpu_dist.resilience.injector",
+    "BackoffPolicy": "tpu_dist.resilience.supervisor",
+    "Supervisor": "tpu_dist.resilience.supervisor",
+    "SupervisorReport": "tpu_dist.resilience.supervisor",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

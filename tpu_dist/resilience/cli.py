@@ -1,0 +1,201 @@
+"""``python -m tpu_dist.resilience`` — run a chaos experiment, emit a report.
+
+The experiment: run the entry point once uninterrupted (the baseline), then
+run it again under the :class:`~tpu_dist.resilience.supervisor.Supervisor`
+with a :class:`~tpu_dist.resilience.faults.FaultPlan` armed, and compare.
+The JSON report answers the questions a recovery SLO asks:
+
+* did the faults actually fire (``faults_fired``, from the event log — a
+  chaos run whose fault never fired is a vacuous pass and FAILS);
+* how many restarts did recovery take (``restarts``);
+* how long did recovery cost (``recovery_wall_s``);
+* did the recovered run converge to the SAME place (``final_loss`` vs
+  ``baseline_final_loss``, gated by ``--parity-atol``) — the end-to-end
+  proof that resume was step-accurate and nothing trained twice or not
+  at all.
+
+Example::
+
+    python -m tpu_dist.resilience --plan kill-worker@step5
+
+kills the demo worker at global step 5 of a 12-step run; the supervisor
+restarts it, it resumes from the epoch-0 checkpoint, and the report shows
+loss parity with the uninterrupted baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import tempfile
+from typing import Optional
+
+from tpu_dist.resilience import events
+from tpu_dist.resilience.entrypoints import CHECKPOINT_DIR_ENV, ENTRY_ENV
+from tpu_dist.resilience.faults import FAULT_PLAN_ENV, FaultPlan, describe
+from tpu_dist.resilience.supervisor import (BackoffPolicy, Supervisor)
+
+_RESULT_PREFIX = "RESULT:"
+
+
+def parse_result_line(text: str) -> Optional[dict]:
+    """The LAST ``RESULT:{...}`` line in ``text`` — a restarted worker's log
+    holds one per completed run; the last is the one that finished."""
+    result = None
+    for line in text.splitlines():
+        if line.startswith(_RESULT_PREFIX):
+            try:
+                result = json.loads(line[len(_RESULT_PREFIX):])
+            except ValueError:
+                continue
+    return result
+
+
+def _worker_cmd() -> list:
+    return [sys.executable, "-m", "tpu_dist.resilience.entrypoints"]
+
+
+def _clean_env(extra: dict) -> dict:
+    """os.environ minus any resilience wiring from OUR caller, plus
+    ``extra`` — each run (baseline, chaos) gets exactly its own knobs."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in (FAULT_PLAN_ENV, events.EVENT_LOG_ENV,
+                        events.ATTEMPT_ENV, CHECKPOINT_DIR_ENV)}
+    env.update(extra)
+    return env
+
+
+def run_baseline(workdir: pathlib.Path, *, timeout: float) -> Optional[dict]:
+    """One uninterrupted run in a subprocess; returns its RESULT dict."""
+    log_path = workdir / "baseline.log"
+    env = _clean_env({CHECKPOINT_DIR_ENV: str(workdir / "baseline-ckpt")})
+    with open(log_path, "wb") as log:
+        code = subprocess.call(_worker_cmd(), env=env, stdout=log,
+                               stderr=subprocess.STDOUT, timeout=timeout)
+    text = log_path.read_text(errors="replace")
+    if code != 0:
+        raise RuntimeError(
+            f"baseline run exited {code}; see {log_path}:\n{text[-2000:]}")
+    return parse_result_line(text)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tpu_dist.resilience",
+        description="Fault-injection chaos runner for tpu_dist training "
+                    "jobs: baseline run, supervised chaos run, JSON report.")
+    p.add_argument("--plan", required=True,
+                   help="fault plan: compact spec (kill-worker@step5), "
+                        "inline JSON, or @path/to/plan.json")
+    p.add_argument("--entry", default=None,
+                   help="module:callable to train with (default: the "
+                        "built-in synthetic-MNIST demo)")
+    p.add_argument("--workers", type=int, default=1,
+                   help="worker processes (default 1; >1 needs a backend "
+                        "with multi-process collectives)")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--deadline", type=float, default=300.0, metavar="S",
+                   help="per-attempt wall-clock deadline (converts hangs "
+                        "into restarts; default 300)")
+    p.add_argument("--backoff", type=float, default=0.5, metavar="S",
+                   help="initial restart backoff, doubling per restart")
+    p.add_argument("--parity-atol", type=float, default=1e-5,
+                   help="max |final_loss - baseline_final_loss| (default "
+                        "1e-5)")
+    p.add_argument("--workdir", default=None,
+                   help="working directory for checkpoints/logs/events "
+                        "(default: a fresh temp dir)")
+    p.add_argument("--report", default=None,
+                   help="also write the JSON report to this path")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="skip the baseline run (no parity check)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="overall per-run timeout for the baseline")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    plan = FaultPlan.parse(args.plan)
+    if not plan:
+        print("error: --plan parsed to an empty fault plan", file=sys.stderr)
+        return 2
+    workdir = pathlib.Path(args.workdir or tempfile.mkdtemp(
+        prefix="tpu-dist-chaos-"))
+    workdir.mkdir(parents=True, exist_ok=True)
+    print(f"chaos workdir: {workdir}", file=sys.stderr)
+    for line in describe(plan):
+        print(f"fault: {line}", file=sys.stderr)
+
+    baseline = None
+    if not args.no_baseline:
+        print("running baseline (no faults)...", file=sys.stderr)
+        baseline = run_baseline(workdir, timeout=args.timeout)
+
+    event_path = workdir / "events.jsonl"
+    extra_env = {
+        FAULT_PLAN_ENV: plan.dumps(),
+        events.EVENT_LOG_ENV: str(event_path),
+        CHECKPOINT_DIR_ENV: str(workdir / "ckpt"),
+    }
+    if args.entry:
+        extra_env[ENTRY_ENV] = args.entry
+    print("running chaos experiment...", file=sys.stderr)
+    sup = Supervisor(
+        _worker_cmd(), num_workers=args.workers,
+        max_restarts=args.max_restarts, attempt_deadline_s=args.deadline,
+        backoff=BackoffPolicy(initial_s=args.backoff),
+        env=_clean_env(extra_env), log_dir=workdir / "logs",
+        event_log=events.EventLog(event_path, role="supervisor"))
+    sup_report = sup.run()
+
+    final = None
+    if sup_report.success:
+        final = parse_result_line(sup.worker_log(
+            sup_report.attempts - 1, 0).read_text(errors="replace"))
+
+    fired = events.read_events(event_path, "fault_fired")
+    report = {
+        "plan": plan.to_json(),
+        "workdir": str(workdir),
+        "success": sup_report.success,
+        "attempts": sup_report.attempts,
+        "restarts": sup_report.restarts,
+        "recovery_wall_s": sup_report.to_json()["recovery_wall_s"],
+        "wall_time_s": sup_report.to_json()["wall_time_s"],
+        "exit_codes": [o.exit_codes for o in sup_report.outcomes],
+        "faults_fired": [
+            {k: r.get(k) for k in ("kind", "at", "step", "op", "mode")
+             if r.get(k) is not None} for r in fired],
+        "events": len(events.read_events(event_path)),
+        "final_loss": (final or {}).get("final_loss"),
+    }
+    ok = sup_report.success and bool(fired)
+    if not fired:
+        report["failure"] = "no fault fired — vacuous chaos run"
+    if baseline is not None:
+        report["baseline_final_loss"] = baseline.get("final_loss")
+        if (report["final_loss"] is not None
+                and report["baseline_final_loss"] is not None):
+            delta = abs(report["final_loss"]
+                        - report["baseline_final_loss"])
+            report["loss_delta"] = delta
+            report["parity_ok"] = delta <= args.parity_atol
+            ok = ok and report["parity_ok"]
+        else:
+            report["parity_ok"] = False
+            ok = False
+    report["ok"] = ok
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.report:
+        pathlib.Path(args.report).write_text(out + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""Supervisable training entry points.
+
+A supervised worker is an ordinary training script with three properties:
+
+1. it trains with ``fit(checkpoint_dir=...)`` so a restart resumes from the
+   newest complete checkpoint instead of step 0;
+2. it converts a liveness verdict
+   (:class:`~tpu_dist.cluster.liveness.PeerUnavailableError`) into the
+   protocol exit code :data:`~tpu_dist.resilience.faults.
+   EXIT_PEER_UNAVAILABLE` so the supervisor restarts it as a victim rather
+   than treating it as a crash;
+3. it reports its result as one machine-parseable ``RESULT:{...}`` stdout
+   line (the same convention as ``tests/multiprocess_harness.py``).
+
+:func:`run_entry` wraps any callable in (2)+(3); :func:`demo_train` is the
+built-in deterministic workload — a synthetic-MNIST run of the reference CNN
+(SURVEY.md R5) small enough for CI, deterministic enough that a killed-and-
+resumed run reproduces the uninterrupted run's final loss bit-for-bit (the
+trainer derives each epoch's RNG keys from the epoch index alone, and the
+dataset's cardinality equals ``steps_per_epoch``, so epoch N sees identical
+batches whether or not the process was restarted in between).
+
+Configuration comes through the environment so the supervisor can launch
+the same argv for every worker of every attempt:
+
+====================================  =======================================
+``TPU_DIST_CHECKPOINT_DIR``           checkpoint/resume directory (unset =
+                                      no checkpointing, no resume)
+``TPU_DIST_DEMO_EPOCHS``              epochs (default 3)
+``TPU_DIST_DEMO_STEPS_PER_EPOCH``     steps per epoch (default 4)
+``TPU_DIST_DEMO_BATCH``               global batch size (default 32)
+``TPU_DIST_ENTRY``                    ``module:callable`` to run instead of
+                                      :func:`demo_train` (``python -m
+                                      tpu_dist.resilience.entrypoints``)
+====================================  =======================================
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Callable, Optional
+
+from tpu_dist.resilience import events
+from tpu_dist.resilience.faults import EXIT_PEER_UNAVAILABLE
+
+CHECKPOINT_DIR_ENV = "TPU_DIST_CHECKPOINT_DIR"
+ENTRY_ENV = "TPU_DIST_ENTRY"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def demo_dataset(*, n: int, batch: int, seed: int = 0):
+    """Synthetic MNIST-shaped data, identical in every process and attempt."""
+    import numpy as np
+
+    from tpu_dist.data.pipeline import Dataset
+
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(n,)).astype(np.int32)
+    return Dataset.from_tensor_slices((x, y)).batch(batch)
+
+
+def demo_train() -> dict:
+    """The chaos-demo workload: reference CNN on synthetic MNIST.
+
+    Returns ``{"final_loss": ..., "epochs_run": ..., "losses": [...]}``;
+    under ``TPU_DIST_CHECKPOINT_DIR`` a restarted run resumes and its
+    ``final_loss`` matches the uninterrupted run's exactly.
+    """
+    from tpu_dist.models.cnn import build_and_compile_cnn_model
+
+    epochs = _env_int("TPU_DIST_DEMO_EPOCHS", 3)
+    steps_per_epoch = _env_int("TPU_DIST_DEMO_STEPS_PER_EPOCH", 4)
+    batch = _env_int("TPU_DIST_DEMO_BATCH", 32)
+    # Dataset cardinality == steps_per_epoch: the load-bearing determinism
+    # property (module docstring) — every epoch consumes exactly one pass.
+    ds = demo_dataset(n=batch * steps_per_epoch, batch=batch)
+    model = build_and_compile_cnn_model(learning_rate=0.01)
+    history = model.fit(
+        ds, epochs=epochs, steps_per_epoch=steps_per_epoch, verbose=0,
+        checkpoint_dir=os.environ.get(CHECKPOINT_DIR_ENV))
+    losses = [round(float(l), 10) for l in history.history.get("loss", [])]
+    return {
+        "final_loss": losses[-1] if losses else None,
+        "epochs_run": len(losses),
+        "losses": losses,
+    }
+
+
+def run_entry(fn: Callable[[], Optional[dict]]) -> int:
+    """Run ``fn`` under the resilience protocol; returns the exit code.
+
+    Emits the ``RESULT:`` line on success; maps PeerUnavailableError to
+    EXIT_PEER_UNAVAILABLE (logged as ``peer_unavailable``) and any other
+    exception to 1 (logged as ``worker_error``).
+    """
+    from tpu_dist.cluster.liveness import PeerUnavailableError
+
+    try:
+        result = fn()
+    except PeerUnavailableError as exc:
+        events.maybe_log("peer_unavailable", error=str(exc))
+        print(f"tpu_dist.resilience: giving up on dead peer: {exc}",
+              file=sys.stderr, flush=True)
+        return EXIT_PEER_UNAVAILABLE
+    except Exception as exc:  # surfaced via exit code; supervisor restarts
+        events.maybe_log("worker_error", error=f"{type(exc).__name__}: {exc}")
+        import traceback
+
+        traceback.print_exc()
+        return 1
+    if result is not None:
+        print("RESULT:" + json.dumps(result), flush=True)
+    return 0
+
+
+def resolve_entry() -> Callable[[], Optional[dict]]:
+    """The callable named by ``$TPU_DIST_ENTRY`` (``module:callable``),
+    defaulting to :func:`demo_train`."""
+    spec = os.environ.get(ENTRY_ENV)
+    if not spec:
+        return demo_train
+    mod_name, sep, fn_name = spec.partition(":")
+    if not sep or not mod_name or not fn_name:
+        raise ValueError(
+            f"${ENTRY_ENV} must be 'module:callable', got {spec!r}")
+    import importlib
+
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    if not callable(fn):
+        raise TypeError(f"{spec} is not callable")
+    return fn
+
+
+if __name__ == "__main__":
+    sys.exit(run_entry(resolve_entry()))

@@ -1,0 +1,250 @@
+"""FaultPlan: a declarative, deterministic chaos specification.
+
+A plan is a list of :class:`FaultSpec` entries, each naming *what* breaks
+(``kind``), *where* (worker ``rank``), *when* (global optimizer ``step`` or
+``epoch``, and the supervisor restart ``attempt``), and kind-specific knobs.
+Plans come from JSON (a file, an inline string, or the
+``TPU_DIST_FAULT_PLAN`` environment variable) or from the compact spec
+grammar used on the CLI::
+
+    kill-worker@step5              # kill rank 0 at global step 5, attempt 0
+    kill@step5:rank1               # same, but rank 1
+    ckpt-fail@epoch0:truncate      # corrupt the epoch-0 checkpoint write
+    ckpt-fail@epoch1:x2            # fail the next 2 checkpoint writes
+    delay-collective@step3:0.5s    # stall host-level collectives 0.5 s
+    hang-collective@step4:rank0    # stall them until the attempt deadline
+    slow-input@step2:0.25s:x4      # slow the input pipeline for 4 steps
+
+Multiple specs join with commas. Determinism is the design center: a fault
+fires at exactly one (rank, attempt, step/epoch) coordinate, so a chaos run
+is reproducible and its report comparable across commits. By default a fault
+arms only on ``attempt`` 0 — the first launch — so the supervised *restart*
+of the same program does not re-kill itself forever; set ``"attempt": null``
+in JSON for a fault that fires on every attempt.
+
+Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
+
+``kill``
+    ``os._exit(exit_code)`` at the target step — a hard worker death with no
+    Python cleanup, the preemption analog.
+``delay_collective`` / ``hang_collective``
+    Sleep inside the host-level collective seam
+    (:func:`tpu_dist.parallel.collectives.install_fault_hook`) — barriers,
+    chief broadcasts and host reductions stall as if the fabric did.
+``checkpoint_fail``
+    Transiently fail (``mode="transient"``) or corrupt (``mode="truncate"``)
+    checkpoint writes through the seam in
+    :mod:`tpu_dist.training.checkpoint`.
+``slow_input``
+    Sleep at host batch boundaries — a straggling input pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Optional, Sequence
+
+#: Canonical fault kinds. CLI aliases (kill-worker, ckpt-fail, ...) normalize
+#: onto these names.
+KINDS = ("kill", "delay_collective", "hang_collective", "checkpoint_fail",
+         "slow_input")
+
+_ALIASES = {
+    "kill-worker": "kill",
+    "kill_worker": "kill",
+    "delay-collective": "delay_collective",
+    "hang-collective": "hang_collective",
+    "ckpt-fail": "checkpoint_fail",
+    "ckpt_fail": "checkpoint_fail",
+    "checkpoint-fail": "checkpoint_fail",
+    "slow-input": "slow_input",
+}
+
+#: Environment variable a worker reads its plan from (set by the CLI /
+#: Supervisor; also settable by hand for code-edit-free chaos runs).
+FAULT_PLAN_ENV = "TPU_DIST_FAULT_PLAN"
+
+#: Exit code of a fault-killed worker — distinguishable from crashes (1) and
+#: from PeerUnavailableError surrender (EXIT_PEER_UNAVAILABLE).
+EXIT_FAULT_KILL = 43
+
+#: Exit code of a worker that surrendered after detecting a dead peer
+#: (liveness verdict) — the supervisor restarts these, they are victims.
+EXIT_PEER_UNAVAILABLE = 17
+
+#: "hang" is implemented as a bounded very-long delay: long enough that the
+#: supervisor's per-attempt deadline is what ends it, short enough that an
+#: unsupervised run eventually unwedges instead of leaking a process forever.
+HANG_SECONDS = 3600.0
+
+_TARGET_RE = re.compile(r"^(step|epoch)(\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One deterministic fault. Frozen: firing state (counts consumed) is
+    tracked by the injector, so a spec can be shared and re-armed."""
+
+    kind: str
+    step: Optional[int] = None      # global step (epoch * steps_per_epoch + i)
+    epoch: Optional[int] = None
+    rank: int = 0
+    attempt: Optional[int] = 0      # None = every restart attempt
+    seconds: float = 1.0            # delay/slow kinds
+    count: int = 1                  # how many times it fires (ckpt/slow kinds)
+    mode: str = "transient"         # checkpoint_fail: transient | truncate
+    exit_code: int = EXIT_FAULT_KILL
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; valid: {list(KINDS)}")
+        if self.step is None and self.epoch is None:
+            raise ValueError(f"fault {self.kind!r} needs a step or epoch")
+        if self.kind == "checkpoint_fail" and self.mode not in (
+                "transient", "truncate"):
+            raise ValueError(
+                f"checkpoint_fail mode must be transient|truncate, "
+                f"got {self.mode!r}")
+        if self.count < 1:
+            raise ValueError(f"fault count must be >= 1, got {self.count}")
+
+    # -- firing predicate (pure; injector owns mutable fired-state) ----------
+
+    def matches_process(self, rank: int, attempt: int) -> bool:
+        return rank == self.rank and (
+            self.attempt is None or attempt == self.attempt)
+
+    def due_at_step(self, global_step: int) -> bool:
+        """Step-triggered kinds: due once the global step reaches the
+        target (``>=`` so steps_per_execution > 1 cannot jump past it)."""
+        return self.step is not None and global_step >= self.step
+
+    def due_at_epoch(self, epoch: int) -> bool:
+        return self.epoch is not None and epoch >= self.epoch
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        return {k: v for k, v in out.items() if v is not None}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "FaultSpec":
+        kind = _ALIASES.get(str(obj.get("kind", "")), obj.get("kind"))
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(obj) - known
+        if unknown:
+            raise ValueError(f"unknown FaultSpec field(s) {sorted(unknown)}")
+        kwargs = dict(obj)
+        kwargs["kind"] = kind
+        return cls(**kwargs)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    faults: tuple = ()
+
+    def __bool__(self) -> bool:
+        return bool(self.faults)
+
+    def for_process(self, rank: int, attempt: int) -> "list[FaultSpec]":
+        return [f for f in self.faults if f.matches_process(rank, attempt)]
+
+    def to_json(self) -> dict:
+        return {"faults": [f.to_json() for f in self.faults]}
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json())
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "FaultPlan":
+        if not isinstance(obj, dict) or "faults" not in obj:
+            raise ValueError(
+                'a JSON fault plan must be {"faults": [...]}')
+        return cls(tuple(FaultSpec.from_json(f) for f in obj["faults"]))
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """Parse a plan from JSON, ``@path/to/plan.json``, or the compact
+        comma-separated spec grammar (module docstring)."""
+        text = text.strip()
+        if not text:
+            return cls()
+        if text.startswith("@"):
+            with open(text[1:], "r", encoding="utf-8") as fh:
+                return cls.from_json(json.load(fh))
+        if text.startswith("{"):
+            return cls.from_json(json.loads(text))
+        return cls(tuple(_parse_compact(s) for s in text.split(",")
+                         if s.strip()))
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        """The plan named by ``$TPU_DIST_FAULT_PLAN``, or None. A plan that
+        does not parse is a hard error — a silently-ignored chaos plan would
+        report a vacuous pass."""
+        raw = os.environ.get(FAULT_PLAN_ENV)
+        if not raw or not raw.strip():
+            return None
+        return cls.parse(raw)
+
+
+def _parse_compact(spec: str) -> FaultSpec:
+    """``kind@target[:modifier]*`` -> FaultSpec (see module docstring)."""
+    spec = spec.strip()
+    if "@" not in spec:
+        raise ValueError(
+            f"bad fault spec {spec!r}: expected kind@stepN or kind@epochN")
+    head, _, tail = spec.partition("@")
+    kind = _ALIASES.get(head.strip(), head.strip())
+    if kind not in KINDS:
+        raise ValueError(
+            f"unknown fault kind {head.strip()!r} in {spec!r}; "
+            f"valid: {sorted(set(KINDS) | set(_ALIASES))}")
+    parts = [p.strip() for p in tail.split(":") if p.strip()]
+    if not parts:
+        raise ValueError(f"bad fault spec {spec!r}: missing @step/@epoch")
+    m = _TARGET_RE.match(parts[0])
+    if not m:
+        raise ValueError(
+            f"bad fault target {parts[0]!r} in {spec!r}: "
+            "expected stepN or epochN")
+    kwargs: dict = {m.group(1): int(m.group(2))}
+    for mod in parts[1:]:
+        if mod.startswith("rank") and mod[4:].isdigit():
+            kwargs["rank"] = int(mod[4:])
+        elif mod.startswith("attempt") and mod[7:].isdigit():
+            kwargs["attempt"] = int(mod[7:])
+        elif mod == "always":
+            kwargs["attempt"] = None
+        elif mod.startswith("x") and mod[1:].isdigit():
+            kwargs["count"] = int(mod[1:])
+        elif mod.endswith("s") and _is_number(mod[:-1]):
+            kwargs["seconds"] = float(mod[:-1])
+        elif mod in ("transient", "truncate"):
+            kwargs["mode"] = mod
+        else:
+            raise ValueError(f"unknown fault modifier {mod!r} in {spec!r}")
+    return FaultSpec(kind=kind, **kwargs)
+
+
+def _is_number(s: str) -> bool:
+    try:
+        float(s)
+    except ValueError:
+        return False
+    return True
+
+
+def describe(plan: FaultPlan) -> Sequence[str]:
+    """Human-readable one-liners, one per fault (CLI/report rendering)."""
+    out = []
+    for f in plan.faults:
+        where = (f"step {f.step}" if f.step is not None
+                 else f"epoch {f.epoch}")
+        when = ("every attempt" if f.attempt is None
+                else f"attempt {f.attempt}")
+        out.append(f"{f.kind} @ {where} on rank {f.rank} ({when})")
+    return out

@@ -1,0 +1,218 @@
+"""FaultInjector: executes a FaultPlan from inside the training loop.
+
+The injector is a standard :class:`~tpu_dist.training.callbacks.Callback` —
+the same hook surface the reference's chaos tooling rode
+(``multi_process_runner`` killing workers between steps, SURVEY.md §4) —
+plus two seams it installs for the fault kinds a callback alone cannot
+reach:
+
+* :func:`tpu_dist.parallel.collectives.install_fault_hook` for
+  ``delay_collective`` / ``hang_collective`` — host-level collectives
+  (barriers, chief broadcasts, host reductions) stall as if the fabric did;
+* :func:`tpu_dist.training.checkpoint.install_write_fault_hook` for
+  ``checkpoint_fail`` — a staged-but-unpublished checkpoint write either
+  raises (``transient``) or is corrupted in place (``truncate``).
+
+Step accounting: ``on_batch_end(step, logs)`` fires once per compiled
+execution with the in-epoch step index; the injector tracks the GLOBAL step
+as ``epoch * steps_per_epoch + step`` so fault coordinates survive resume
+(a restarted run that restores epoch N re-enters the loop at the same
+global step numbering). ``FaultSpec.due_at_step`` uses ``>=``, so
+``steps_per_execution > 1`` cannot jump past a target.
+
+Kills are ``os._exit(exit_code)`` — no Python cleanup, no atexit, no
+``jax.distributed.shutdown``: the closest single-process analog of a
+preempted host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional, Sequence
+
+from tpu_dist.resilience import events
+from tpu_dist.resilience.faults import (FaultPlan, FaultSpec, HANG_SECONDS)
+from tpu_dist.training.callbacks import Callback
+
+logger = logging.getLogger("tpu_dist.resilience")
+
+
+class FaultInjector(Callback):
+    """Arms a process's slice of a FaultPlan for one fit() run."""
+
+    wants_batches = True  # global-step tracking needs per-execution hooks
+
+    def __init__(self, faults: Sequence[FaultSpec], *, steps_per_epoch: int,
+                 event_log: Optional[events.EventLog] = None):
+        self.faults = list(faults)
+        self.steps_per_epoch = int(steps_per_epoch)
+        self._events = event_log
+        #: Remaining firings per fault (specs are frozen; state lives here).
+        self._remaining = [f.count for f in self.faults]
+        self._epoch = 0
+        self._global_step = 0
+        self._prev_collective_hook = None
+        self._prev_write_hook = None
+        self._installed = False
+
+    # -- event plumbing ------------------------------------------------------
+
+    def _log(self, event: str, **fields) -> None:
+        try:
+            log = self._events or events.log_from_env()
+            if log is not None:
+                log.append(event, attempt=events.current_attempt(), **fields)
+        except OSError:  # observability must never fail the run
+            pass
+
+    # -- seam installation ---------------------------------------------------
+
+    def on_train_begin(self) -> None:
+        if any(f.kind in ("delay_collective", "hang_collective")
+               for f in self.faults):
+            from tpu_dist.parallel import collectives
+
+            self._prev_collective_hook = collectives.install_fault_hook(
+                self._collective_hook)
+        if any(f.kind == "checkpoint_fail" for f in self.faults):
+            from tpu_dist.training import checkpoint
+
+            self._prev_write_hook = checkpoint.install_write_fault_hook(
+                self._write_hook)
+        self._installed = True
+        for f in self.faults:
+            self._log("fault_armed", kind=f.kind, step=f.step, epoch=f.epoch,
+                      rank=f.rank)
+        if events.current_attempt() > 0:
+            self._log("resumed")
+
+    def on_train_end(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        if any(f.kind in ("delay_collective", "hang_collective")
+               for f in self.faults):
+            from tpu_dist.parallel import collectives
+
+            collectives.install_fault_hook(self._prev_collective_hook)
+        if any(f.kind == "checkpoint_fail" for f in self.faults):
+            from tpu_dist.training import checkpoint
+
+            checkpoint.install_write_fault_hook(self._prev_write_hook)
+
+    # -- firing --------------------------------------------------------------
+
+    def on_epoch_begin(self, epoch: int) -> None:
+        self._epoch = epoch
+        self._global_step = epoch * self.steps_per_epoch
+        for i, f in enumerate(self.faults):
+            if (f.kind == "kill" and self._remaining[i] > 0
+                    and f.step is None and f.due_at_epoch(epoch)):
+                self._fire_kill(i, f, at=f"epoch {epoch}")
+
+    def on_batch_end(self, step: int, logs: dict) -> None:
+        # ``step`` is the in-epoch index of the last step in the execution
+        # that just finished; faults address the GLOBAL step so their
+        # coordinates are stable across resume.
+        gstep = self._epoch * self.steps_per_epoch + step
+        self._global_step = gstep
+        for i, f in enumerate(self.faults):
+            if self._remaining[i] <= 0 or f.step is None:
+                continue
+            if not f.due_at_step(gstep):
+                continue
+            if f.kind == "kill":
+                self._fire_kill(i, f, at=f"step {gstep}")
+            elif f.kind == "slow_input":
+                self._remaining[i] -= 1
+                self._log("fault_fired", kind=f.kind, step=gstep,
+                          seconds=f.seconds)
+                time.sleep(f.seconds)
+
+    def _fire_kill(self, i: int, f: FaultSpec, *, at: str) -> None:
+        self._remaining[i] -= 1
+        self._log("fault_fired", kind="kill", at=at, exit_code=f.exit_code)
+        logger.warning("fault injection: killing process at %s "
+                       "(exit %d)", at, f.exit_code)
+        os._exit(f.exit_code)
+
+    # -- seam hooks ----------------------------------------------------------
+
+    def _collective_hook(self, op: str) -> None:
+        for i, f in enumerate(self.faults):
+            if f.kind not in ("delay_collective", "hang_collective"):
+                continue
+            if self._remaining[i] <= 0:
+                continue
+            due = (f.due_at_step(self._global_step) if f.step is not None
+                   else f.due_at_epoch(self._epoch))
+            if not due:
+                continue
+            self._remaining[i] -= 1
+            seconds = (HANG_SECONDS if f.kind == "hang_collective"
+                       else f.seconds)
+            self._log("fault_fired", kind=f.kind, op=op, seconds=seconds)
+            logger.warning("fault injection: stalling collective %r for "
+                           "%.1fs", op, seconds)
+            time.sleep(seconds)
+        if self._prev_collective_hook is not None:
+            self._prev_collective_hook(op)
+
+    def _write_hook(self, stage_dir, step: int) -> None:
+        for i, f in enumerate(self.faults):
+            if f.kind != "checkpoint_fail" or self._remaining[i] <= 0:
+                continue
+            due = (f.due_at_epoch(step) if f.epoch is not None
+                   else f.due_at_step(step))
+            if not due:
+                continue
+            self._remaining[i] -= 1
+            self._log("fault_fired", kind="checkpoint_fail", mode=f.mode,
+                      step=step)
+            if f.mode == "transient":
+                raise OSError(
+                    f"injected transient checkpoint write failure at "
+                    f"step {step}")
+            _truncate_stage(stage_dir)
+        if self._prev_write_hook is not None:
+            self._prev_write_hook(stage_dir, step)
+
+
+def _truncate_stage(stage_dir) -> None:
+    """Cut every staged .npz short — the footprint of a writer that died
+    mid-write on a filesystem whose publish was not atomic. The zip central
+    directory lives at the end of the file, so a truncated npz fails to
+    open and restore-side validation must reject the step."""
+    import pathlib
+
+    for npz in sorted(pathlib.Path(stage_dir).glob("*.npz")):
+        size = npz.stat().st_size
+        with open(npz, "r+b") as fh:
+            fh.truncate(max(1, size // 2))
+        logger.warning("fault injection: truncated %s to %d bytes",
+                       npz, max(1, size // 2))
+
+
+def maybe_injector_from_env(*, steps_per_epoch: int,
+                            rank: Optional[int] = None,
+                            attempt: Optional[int] = None
+                            ) -> Optional[FaultInjector]:
+    """Build the injector for this process's slice of ``$TPU_DIST_FAULT_PLAN``,
+    or None when no plan is set or no fault targets (rank, attempt)."""
+    plan = FaultPlan.from_env()
+    if not plan:
+        return None
+    if rank is None:
+        import jax
+
+        rank = jax.process_index()
+    if attempt is None:
+        attempt = events.current_attempt()
+    mine = plan.for_process(rank, attempt)
+    if not mine:
+        return None
+    logger.info("fault plan armed for rank %d attempt %d: %d fault(s)",
+                rank, attempt, len(mine))
+    return FaultInjector(mine, steps_per_epoch=steps_per_epoch)

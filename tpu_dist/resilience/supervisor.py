@@ -1,0 +1,301 @@
+"""Supervisor: launch, watch, restart, resume.
+
+The reference stack delegates this whole layer to an external cluster
+manager — Kubernetes restarts a dead worker pod, the TF server blocks until
+the cluster re-forms (SURVEY.md §5.3: fault tolerance "is provided by the
+surrounding infrastructure, not the strategy"). This module is that
+surrounding infrastructure, scaled to one host: a parent process that
+
+* launches the training job as ``num_workers`` subprocesses (the same
+  loopback TF_CONFIG fabrication as ``tests/multiprocess_harness.py``, with
+  fresh coordination-service ports per attempt — the old coordinator died
+  with rank 0);
+* watches exit codes, classifying them against the resilience protocol
+  (0 clean, :data:`~tpu_dist.resilience.faults.EXIT_FAULT_KILL` injected
+  kill, :data:`~tpu_dist.resilience.faults.EXIT_PEER_UNAVAILABLE` liveness
+  surrender, anything else a crash);
+* gang-restarts on failure — synchronous data parallelism cannot run a
+  partial cluster, so when one rank dies the rest are grace-killed and the
+  whole gang relaunches (the reference's own semantics: every collective
+  blocks until the full cluster is back) — with exponential backoff, a
+  restart budget, and a per-attempt wall-clock deadline that converts hangs
+  (a wedged collective, an injected ``hang_collective``) into restarts;
+* resumes step-accurately for free: workers re-enter ``fit(checkpoint_dir=)``
+  and restore the newest checkpoint that passes manifest validation.
+
+Worker stdout/stderr stream to per-(attempt, rank) log files — PIPEs would
+deadlock once a killed worker stops draining — and every lifecycle event
+lands in the shared :mod:`~tpu_dist.resilience.events` JSONL log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import pathlib
+import subprocess
+import sys
+import time
+from typing import Optional, Sequence
+
+from tpu_dist.resilience import events
+from tpu_dist.resilience.faults import (EXIT_FAULT_KILL,
+                                        EXIT_PEER_UNAVAILABLE)
+
+logger = logging.getLogger("tpu_dist.resilience")
+
+#: How long a surviving rank gets to exit on its own after a gang member
+#: died, before the supervisor kills it (it is usually wedged in a
+#: collective waiting for the dead peer).
+GANG_GRACE_S = 5.0
+
+_POLL_S = 0.1
+
+
+@dataclasses.dataclass(frozen=True)
+class BackoffPolicy:
+    """Exponential restart backoff: ``min(max_s, initial_s * multiplier**n)``
+    before restart attempt ``n`` (0-based over *restarts*, so the first
+    restart waits ``initial_s``)."""
+
+    initial_s: float = 0.5
+    multiplier: float = 2.0
+    max_s: float = 30.0
+
+    def delay(self, restart: int) -> float:
+        if restart < 0:
+            raise ValueError(f"restart index must be >= 0, got {restart}")
+        return min(self.max_s, self.initial_s * self.multiplier ** restart)
+
+
+@dataclasses.dataclass
+class AttemptOutcome:
+    attempt: int
+    exit_codes: list
+    duration_s: float
+    deadline_hit: bool = False
+
+    @property
+    def succeeded(self) -> bool:
+        return (not self.deadline_hit
+                and all(c == 0 for c in self.exit_codes))
+
+
+@dataclasses.dataclass
+class SupervisorReport:
+    success: bool
+    attempts: int
+    restarts: int
+    outcomes: list
+    wall_time_s: float
+    #: Wall-clock from the first detected failure to final success (the
+    #: recovery cost a chaos report quotes); None when nothing failed.
+    recovery_wall_s: Optional[float] = None
+
+    def to_json(self) -> dict:
+        return {
+            "success": self.success,
+            "attempts": self.attempts,
+            "restarts": self.restarts,
+            "wall_time_s": round(self.wall_time_s, 3),
+            "recovery_wall_s": (None if self.recovery_wall_s is None
+                                else round(self.recovery_wall_s, 3)),
+            "exit_codes": [o.exit_codes for o in self.outcomes],
+        }
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def classify_exit(code: Optional[int]) -> str:
+    if code == 0:
+        return "clean"
+    if code == EXIT_FAULT_KILL:
+        return "fault_kill"
+    if code == EXIT_PEER_UNAVAILABLE:
+        return "peer_unavailable"
+    if code is not None and code < 0:
+        return f"signal_{-code}"
+    return "crash"
+
+
+class Supervisor:
+    """Run ``cmd`` as a supervised (optionally multi-worker) job.
+
+    ``cmd`` is the worker argv (e.g. ``[sys.executable, "-m",
+    "tpu_dist.resilience.entrypoints"]``); every worker of every attempt
+    runs the same argv and is differentiated through the environment:
+    per-rank ``TF_CONFIG`` (only when ``num_workers > 1``),
+    ``TPU_DIST_RESILIENCE_ATTEMPT``, and whatever the caller passes in
+    ``env``.
+    """
+
+    def __init__(self, cmd: Sequence[str], *, num_workers: int = 1,
+                 max_restarts: int = 3,
+                 attempt_deadline_s: Optional[float] = None,
+                 backoff: BackoffPolicy = BackoffPolicy(),
+                 env: Optional[dict] = None,
+                 log_dir: str | os.PathLike = "resilience-logs",
+                 event_log: Optional[events.EventLog] = None):
+        if num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        self.cmd = list(cmd)
+        self.num_workers = num_workers
+        self.max_restarts = max_restarts
+        self.attempt_deadline_s = attempt_deadline_s
+        self.backoff = backoff
+        self.env = dict(env or {})
+        self.log_dir = pathlib.Path(log_dir)
+        self.events = event_log
+
+    # -- launching -----------------------------------------------------------
+
+    def _worker_env(self, rank: int, attempt: int) -> dict:
+        env = dict(os.environ)
+        env.update(self.env)
+        env[events.ATTEMPT_ENV] = str(attempt)
+        if self.num_workers > 1:
+            from tpu_dist.cluster.config import make_local_cluster
+
+            # Fresh ports every attempt: rank 0 hosted the coordination
+            # service and took it down with itself; the old port may also
+            # sit in TIME_WAIT.
+            if rank == 0:
+                self._base_port = _free_port()
+            cfg = make_local_cluster(
+                self.num_workers, base_port=self._base_port)[rank]
+            env.update({
+                "TF_CONFIG": json.dumps(cfg),
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                "PALLAS_AXON_POOL_IPS": "",
+            })
+        return env
+
+    def worker_log(self, attempt: int, rank: int) -> pathlib.Path:
+        return self.log_dir / f"attempt{attempt}-rank{rank}.log"
+
+    def _launch(self, attempt: int) -> list:
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        procs = []
+        for rank in range(self.num_workers):
+            log_path = self.worker_log(attempt, rank)
+            # The file object can close right after spawn; the child holds
+            # its own descriptor.
+            with open(log_path, "wb") as log:
+                procs.append(subprocess.Popen(
+                    self.cmd, env=self._worker_env(rank, attempt),
+                    stdout=log, stderr=subprocess.STDOUT))
+        self._log("attempt_start", attempt=attempt,
+                  pids=[p.pid for p in procs])
+        return procs
+
+    def _log(self, event: str, **fields) -> None:
+        if self.events is not None:
+            try:
+                self.events.append(event, **fields)
+            except OSError:
+                pass
+
+    # -- watching ------------------------------------------------------------
+
+    def _watch(self, procs: list, attempt: int) -> AttemptOutcome:
+        """Block until the gang exits, a member fails, or the deadline hits.
+
+        Gang semantics: the first nonzero exit (or the deadline) condemns
+        the attempt — survivors get GANG_GRACE_S to exit on their own, then
+        are killed.
+        """
+        t0 = time.monotonic()
+        deadline = (t0 + self.attempt_deadline_s
+                    if self.attempt_deadline_s else None)
+        failed = False
+        deadline_hit = False
+        reported: set = set()
+        while True:
+            live = [p for p in procs if p.poll() is None]
+            for rank, p in enumerate(procs):
+                code = p.poll()
+                if code is not None and rank not in reported:
+                    reported.add(rank)
+                    self._log("worker_exit", attempt=attempt, rank=rank,
+                              code=code, kind=classify_exit(code))
+                    logger.info("supervisor: rank %d exited %s (%s)",
+                                rank, code, classify_exit(code))
+                    if code != 0:
+                        failed = True
+            if failed or not live:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                deadline_hit = True
+                self._log("attempt_deadline", attempt=attempt,
+                          deadline_s=self.attempt_deadline_s)
+                logger.warning("supervisor: attempt %d exceeded its %.1fs "
+                               "deadline", attempt, self.attempt_deadline_s)
+                break
+            time.sleep(_POLL_S)
+        # Grace period, then kill whoever is left.
+        grace_end = time.monotonic() + (0 if deadline_hit else GANG_GRACE_S)
+        for p in procs:
+            while p.poll() is None and time.monotonic() < grace_end:
+                time.sleep(_POLL_S)
+            if p.poll() is None:
+                p.kill()
+        codes = []
+        for rank, p in enumerate(procs):
+            code = p.wait()
+            codes.append(code)
+            if rank not in reported:
+                self._log("worker_exit", attempt=attempt, rank=rank,
+                          code=code, kind=classify_exit(code))
+        return AttemptOutcome(attempt=attempt, exit_codes=codes,
+                              duration_s=time.monotonic() - t0,
+                              deadline_hit=deadline_hit)
+
+    # -- the supervision loop ------------------------------------------------
+
+    def run(self) -> SupervisorReport:
+        t_start = time.monotonic()
+        t_first_failure: Optional[float] = None
+        outcomes: list = []
+        attempt = 0
+        while True:
+            outcome = self._watch(self._launch(attempt), attempt)
+            outcomes.append(outcome)
+            if outcome.succeeded:
+                if attempt > 0:
+                    self._log("recovered", attempt=attempt,
+                              restarts=attempt)
+                break
+            if t_first_failure is None:
+                t_first_failure = time.monotonic()
+            if attempt >= self.max_restarts:
+                logger.error("supervisor: restart budget (%d) exhausted",
+                             self.max_restarts)
+                break
+            delay = self.backoff.delay(attempt)
+            self._log("restart", attempt=attempt + 1, backoff_s=delay,
+                      prior_exit_codes=outcome.exit_codes)
+            logger.info("supervisor: restarting (attempt %d) after %.2fs "
+                        "backoff", attempt + 1, delay)
+            time.sleep(delay)
+            attempt += 1
+        wall = time.monotonic() - t_start
+        success = outcomes[-1].succeeded
+        recovery = (time.monotonic() - t_first_failure
+                    if success and t_first_failure is not None else None)
+        report = SupervisorReport(
+            success=success, attempts=len(outcomes),
+            restarts=len(outcomes) - 1, outcomes=outcomes,
+            wall_time_s=wall, recovery_wall_s=recovery)
+        self._log("run_complete", **report.to_json())
+        return report

@@ -104,8 +104,18 @@ class ModelCheckpoint(Callback):
             if not better:
                 return
             self._best = current
-        checkpoint.save(self.directory, self.model, step=epoch,
-                        max_to_keep=self.max_to_keep)
+        try:
+            checkpoint.save(self.directory, self.model, step=epoch,
+                            max_to_keep=self.max_to_keep)
+        except OSError as exc:
+            # A failed write costs one checkpoint interval, never the run:
+            # training state is still live, and the next epoch retries.
+            logger.warning("ModelCheckpoint: step %d write failed (%s); "
+                           "continuing without it", epoch, exc)
+            from tpu_dist.resilience import events
+
+            events.maybe_log("checkpoint_write_failed", step=epoch,
+                             error=str(exc))
 
 
 class EarlyStopping(Callback):

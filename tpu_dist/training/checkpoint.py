@@ -109,6 +109,30 @@ def _step_dir(directory: pathlib.Path, step: int) -> pathlib.Path:
     return directory / f"ckpt-{step}"
 
 
+#: Fault-injection seam (tpu_dist.resilience): called on the chief with the
+#: fully staged checkpoint directory right before the atomic publish. A hook
+#: may raise OSError (a transient write failure — the stage is discarded and
+#: nothing is published) or corrupt the staged files in place (simulating a
+#: mid-write crash on a filesystem whose rename is not atomic); restore-side
+#: manifest validation must then reject the published step. None in
+#: production — one pointer check per save.
+_WRITE_FAULT_HOOK = None
+
+
+def install_write_fault_hook(hook):
+    """Install (or, with None, remove) the checkpoint write fault hook;
+    returns the previously installed hook. ``hook(stage_dir, step)``."""
+    global _WRITE_FAULT_HOOK
+    prev = _WRITE_FAULT_HOOK
+    _WRITE_FAULT_HOOK = hook
+    return prev
+
+
+def _fire_write_fault(stage: pathlib.Path, step: int) -> None:
+    if _WRITE_FAULT_HOOK is not None:
+        _WRITE_FAULT_HOOK(stage, step)
+
+
 def save(directory: str | os.PathLike, model_or_variables, *, step: int,
          max_to_keep: Optional[int] = None,
          sharded: bool = False) -> Optional[str]:
@@ -150,31 +174,42 @@ def save(directory: str | os.PathLike, model_or_variables, *, step: int,
         for leaf in jax.tree_util.tree_leaves(saveable):
             if _needs_allgather(leaf):
                 _to_host(leaf)
+    write_error: Optional[OSError] = None
     if bootstrap.is_chief():
         directory.mkdir(parents=True, exist_ok=True)
         target = _step_dir(directory, step)
         flat = _flatten(saveable)
         # Atomic publish: stage into a temp dir, then rename into place.
-        with tempfile.TemporaryDirectory(dir=directory) as tmp:
-            tmp_path = pathlib.Path(tmp) / "stage"
-            tmp_path.mkdir()
-            np.savez(tmp_path / _ARRAYS, **flat)
-            (tmp_path / _MANIFEST).write_text(json.dumps({
-                "step": step,
-                "keys": sorted(flat),
-                "format": _FORMAT_V1,
-            }))
-            if target.exists():
-                import shutil
+        # A write failure (real, or injected through the fault seam) must
+        # not skip the closing barrier — peers are already waiting there,
+        # so raising early would trade a lost checkpoint for a cluster-wide
+        # hang. Record, rendezvous, then propagate.
+        try:
+            with tempfile.TemporaryDirectory(dir=directory) as tmp:
+                tmp_path = pathlib.Path(tmp) / "stage"
+                tmp_path.mkdir()
+                np.savez(tmp_path / _ARRAYS, **flat)
+                (tmp_path / _MANIFEST).write_text(json.dumps({
+                    "step": step,
+                    "keys": sorted(flat),
+                    "format": _FORMAT_V1,
+                }))
+                _fire_write_fault(tmp_path, step)
+                if target.exists():
+                    import shutil
 
-                shutil.rmtree(target)
-            os.replace(tmp_path, target)
-        (directory / _POINTER).write_text(str(step))
-        path = str(target)
-        logger.info("checkpoint step %d written to %s", step, target)
-        if max_to_keep is not None:
-            _gc(directory, max_to_keep)
+                    shutil.rmtree(target)
+                os.replace(tmp_path, target)
+            (directory / _POINTER).write_text(str(step))
+            path = str(target)
+            logger.info("checkpoint step %d written to %s", step, target)
+            if max_to_keep is not None:
+                _gc(directory, max_to_keep)
+        except OSError as exc:
+            write_error = exc
     bootstrap.barrier(f"checkpoint_save_{step}")
+    if write_error is not None:
+        raise write_error
     return path
 
 
@@ -249,18 +284,31 @@ def _save_sharded(directory: pathlib.Path, saveable, *, step: int,
             "leaves": meta,
         }))
     bootstrap.barrier(f"checkpoint_written_{step}")
+    write_error: Optional[OSError] = None
     if bootstrap.is_chief():
-        if target.exists():
+        # Same barrier-before-raise contract as the v1 path: a publish
+        # failure must not strand peers at the closing rendezvous.
+        try:
+            _fire_write_fault(stage, step)
+            if target.exists():
+                import shutil
+
+                shutil.rmtree(target)
+            os.replace(stage, target)
+            (directory / _POINTER).write_text(str(step))
+            logger.info(
+                "sharded checkpoint step %d written to %s (%d writers)",
+                step, target, jax.process_count())
+            if max_to_keep is not None:
+                _gc(directory, max_to_keep)
+        except OSError as exc:
+            write_error = exc
             import shutil
 
-            shutil.rmtree(target)
-        os.replace(stage, target)
-        (directory / _POINTER).write_text(str(step))
-        logger.info("sharded checkpoint step %d written to %s (%d writers)",
-                    step, target, jax.process_count())
-        if max_to_keep is not None:
-            _gc(directory, max_to_keep)
+            shutil.rmtree(stage, ignore_errors=True)
     bootstrap.barrier(f"checkpoint_save_{step}")
+    if write_error is not None:
+        raise write_error
     return str(target)
 
 
@@ -363,6 +411,97 @@ def latest_step(directory: str | os.PathLike) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+# -- integrity validation (resume must never trust a half-written step) ------
+
+def _npz_names(path: pathlib.Path) -> Optional[set]:
+    """Member names of an npz, or None when the file is unreadable — a
+    truncated write leaves a zip without its central directory, which
+    np.load rejects at open."""
+    import zipfile
+
+    try:
+        with np.load(path) as z:
+            return set(z.files)
+    except (OSError, ValueError, zipfile.BadZipFile):
+        return None
+
+
+def validate_step_dir(target: str | os.PathLike) -> Optional[str]:
+    """Why checkpoint directory ``target`` is NOT safe to restore from, or
+    None when it is.
+
+    Validation is structural (manifest parses, array containers open, v1
+    key sets agree), not content hashing: the threat model is a write cut
+    short — by a crash, a preemption, or an injected fault — on a path
+    where the atomic temp+rename publish was subverted (non-atomic network
+    filesystems, partial rsync copies). Cheap enough to run on every
+    resume."""
+    target = pathlib.Path(target)
+    if not target.is_dir():
+        return "missing checkpoint directory"
+    mf = target / _MANIFEST
+    if not mf.is_file():
+        return "missing manifest.json"
+    try:
+        manifest = json.loads(mf.read_text())
+    except ValueError:
+        return "manifest.json does not parse"
+    fmt = manifest.get("format")
+    if fmt == _FORMAT_V1:
+        names = _npz_names(target / _ARRAYS)
+        if names is None:
+            return f"{_ARRAYS} is unreadable (truncated write?)"
+        keys = manifest.get("keys")
+        if keys is not None and set(keys) != names:
+            missing = sorted(set(keys) - names)[:3]
+            return (f"{_ARRAYS} does not match manifest keys "
+                    f"(e.g. missing {missing})")
+        return None
+    if fmt == _FORMAT_V2:
+        if _npz_names(target / _ARRAYS) is None:
+            return f"chief {_ARRAYS} is unreadable (truncated write?)"
+        for idx_file in sorted(target.glob("shards-*.json")):
+            try:
+                json.loads(idx_file.read_text())
+            except ValueError:
+                return f"{idx_file.name} does not parse"
+            arr = target / idx_file.name.replace(
+                "shards-", "arrays-shard-").replace(".json", ".npz")
+            if _npz_names(arr) is None:
+                return f"{arr.name} is unreadable (truncated write?)"
+        return None
+    return f"unknown checkpoint format {fmt!r}"
+
+
+def is_complete(directory: str | os.PathLike, step: int) -> bool:
+    return validate_step_dir(_step_dir(pathlib.Path(directory), step)) is None
+
+
+def latest_complete_step(directory: str | os.PathLike) -> Optional[int]:
+    """The newest step that passes :func:`validate_step_dir` — the resume
+    anchor. The pointer file is a hint, not an authority: a fault between
+    publish and pointer update (or a corrupt published step) must cost at
+    most one checkpoint interval, never the whole run."""
+    directory = pathlib.Path(directory)
+    pointed = latest_step(directory)
+    if pointed is not None and is_complete(directory, pointed):
+        return pointed
+    for step in reversed(all_steps(directory)):
+        if step == pointed:
+            continue  # already rejected above
+        reason = validate_step_dir(_step_dir(directory, step))
+        if reason is None:
+            if pointed is not None:
+                logger.warning(
+                    "checkpoint step %s is incomplete (%s); resuming from "
+                    "step %d instead", pointed,
+                    validate_step_dir(_step_dir(directory, pointed)), step)
+            return step
+        logger.warning("skipping incomplete checkpoint step %d: %s",
+                       step, reason)
+    return None
+
+
 def restore(directory: str | os.PathLike, template: Any, *,
             step: Optional[int] = None) -> tuple[Any, int]:
     """Load checkpoint arrays into the structure of ``template``.
@@ -376,30 +515,46 @@ def restore(directory: str | os.PathLike, template: Any, *,
         # Resolve on process 0 and broadcast the choice: checkpoints are
         # chief-written, so peers may have no local copy (or, on an
         # eventually-consistent shared FS, see a different latest step).
+        # "Latest" means latest COMPLETE: a step that fails manifest
+        # validation (half-written, truncated, corrupted) is skipped in
+        # favor of the newest one that verifies — a fault injected
+        # mid-write costs one checkpoint interval, never a corrupt restore.
         if jax.process_count() > 1:
             from tpu_dist.parallel.collectives import broadcast_from_chief
 
-            local = latest_step(directory) if bootstrap.process_index() == 0 \
-                else None
+            local = latest_complete_step(directory) \
+                if bootstrap.process_index() == 0 else None
             chosen = int(broadcast_from_chief(
                 np.int64(-1 if local is None else local)))
             step = None if chosen < 0 else chosen
         else:
-            step = latest_step(directory)
+            step = latest_complete_step(directory)
         if step is None:
-            raise FileNotFoundError(f"no checkpoints under {directory}")
+            raise FileNotFoundError(
+                f"no complete checkpoints under {directory}")
     target = _step_dir(directory, step)
-    # The FORMAT branch must be uniform cluster-wide: the v2 path returns
-    # without broadcasting, so a peer whose (eventually-consistent) FS view
-    # is stale taking the v1 branch would hang in broadcast_from_chief
-    # waiting for a collective the chief never joins. Chief decides,
-    # everyone follows; a stale peer on the v2 path then fails with the
-    # clear missing-shards error instead of deadlocking.
-    is_v2 = _manifest(target).get("format") == _FORMAT_V2
+    # Integrity gate + FORMAT branch, decided by the chief and broadcast so
+    # they are uniform cluster-wide (checkpoints are chief-written — peers
+    # may hold no local copy, and the v2 path returns without broadcasting,
+    # so a peer taking the v1 branch alone would hang in
+    # broadcast_from_chief waiting for a collective the chief never joins).
+    # Verdict encoding: -1 invalid, 0 restore as v1, 1 restore as v2.
+    if bootstrap.process_index() == 0:
+        reason = validate_step_dir(target)
+        verdict = -1 if reason is not None else int(
+            _manifest(target).get("format") == _FORMAT_V2)
+    else:
+        reason, verdict = None, 0  # placeholder; chief's value wins below
     if jax.process_count() > 1:
         from tpu_dist.parallel.collectives import broadcast_from_chief
 
-        is_v2 = bool(int(broadcast_from_chief(np.int64(int(is_v2)))))
+        verdict = int(broadcast_from_chief(np.int64(verdict)))
+    if verdict < 0:
+        raise ValueError(
+            f"checkpoint step {step} at {target} failed validation"
+            + (f": {reason}" if reason else "")
+            + "; refusing to restore from an incomplete checkpoint")
+    is_v2 = bool(verdict)
     if is_v2:
         # v2 (sharded) lives on a shared FS by contract: every process
         # assembles directly from the shard files — no broadcast needed.

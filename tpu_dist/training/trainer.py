@@ -514,6 +514,15 @@ class Trainer:
                     "cardinality (e.g. repeated/generator datasets)")
 
         callbacks = list(callbacks)
+        # Code-edit-free chaos wiring (tpu_dist.resilience): a fault plan in
+        # $TPU_DIST_FAULT_PLAN — set by the resilience CLI / Supervisor —
+        # rides this fit as one more callback. None in production runs.
+        from tpu_dist.resilience.injector import maybe_injector_from_env
+
+        fault_injector = maybe_injector_from_env(
+            steps_per_epoch=steps_per_epoch)
+        if fault_injector is not None:
+            callbacks.append(fault_injector)
         if checkpoint_dir is not None:
             # SURVEY.md §5.4: fit(checkpoint_dir=) = chief-writes-per-epoch +
             # resume-from-latest. A restored step N means epoch N finished.
@@ -526,6 +535,10 @@ class Trainer:
                 initial_epoch = max(initial_epoch, restored + 1)
                 logger.info("resumed from checkpoint step %d; starting at "
                             "epoch %d", restored, initial_epoch)
+                from tpu_dist.resilience import events
+
+                events.maybe_log("checkpoint_resume", step=restored,
+                                 initial_epoch=initial_epoch)
             except FileNotFoundError:
                 pass
             # Don't double up save+barrier work if the caller already passed
