@@ -1,14 +1,16 @@
 #!/usr/bin/env bash
 # Repo gate: shardcheck static analysis, the resilience smoke chaos run,
-# then the tier-1 test suite.
+# the observe telemetry smoke/bench, then the tier-1 test suite.
 #
 # Usage: scripts/check.sh
 #
 # Step 1 runs `python -m tpu_dist.analysis` over the package and fails on
 # any error-severity finding (the dogfooded self-check — see README.md
 # "Static analysis"). Step 2 is the supervised kill/restart/resume demo
-# (README.md "Fault tolerance & chaos testing"). Step 3 is the tier-1
-# pytest command from ROADMAP.md.
+# (README.md "Fault tolerance & chaos testing"). Step 3 benchmarks the
+# telemetry overhead and gates the instrumented series for non-vacuity
+# (README.md "Observability"; writes BENCH_OBSERVE.json). Step 4 is the
+# tier-1 pytest command from ROADMAP.md.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -28,6 +30,23 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
   || { echo "check.sh: resilience smoke chaos run failed (see $smoke_dir)" >&2
        exit 1; }
 rm -rf "$smoke_dir"
+
+echo "== observe-smoke: telemetry overhead bench + series validation =="
+# Off/on/off runs of the demo workload on one compiled step; writes
+# BENCH_OBSERVE.json and fails when telemetry costs more than 5% steps/s.
+# The summarize pass then re-reads the instrumented series and fails
+# unless BOTH step timing and collective counts are present — an empty
+# series passing silently is exactly the failure mode this stage exists
+# to catch.
+obs_dir=$(mktemp -d /tmp/tpu-dist-observe.XXXXXX)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m tpu_dist.observe bench \
+  --workdir "$obs_dir" --out BENCH_OBSERVE.json >/dev/null \
+  || { echo "check.sh: observe bench failed (see $obs_dir)" >&2; exit 1; }
+timeout -k 10 60 env JAX_PLATFORMS=cpu python -m tpu_dist.observe \
+  summarize "$obs_dir/on/metrics.jsonl" --require step,collective \
+  >/dev/null \
+  || { echo "check.sh: instrumented series failed validation" >&2; exit 1; }
+rm -rf "$obs_dir"
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -1,0 +1,22 @@
+"""shardcheck bad fixture: observe metric recording inside jit (SC103).
+
+A counter bumped inside a jitted function fires once at trace time — the
+metric reads 1 after a million steps. Same for distributions reached
+through the module path.
+"""
+
+import jax
+from tpu_dist.observe import metrics
+
+
+@jax.jit
+def counted_step(x):
+    metrics.inc("step.count")
+    return x * 2.0
+
+
+@jax.jit
+def measured_step(x):
+    loss = (x * x).sum()
+    metrics.observe_value("loss", loss)
+    return loss

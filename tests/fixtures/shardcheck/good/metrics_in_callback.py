@@ -1,0 +1,26 @@
+"""shardcheck good fixture: observe metrics recorded from eager code only.
+
+Recording happens in a callback / around the jitted call, never inside it;
+the only observe calls inside jit are the allowlisted pure reads.
+"""
+
+import jax
+from tpu_dist.observe import metrics
+
+
+@jax.jit
+def step(x):
+    if metrics.enabled():  # pure read: allowlisted under jit
+        return x * 2.0
+    return x * 2.0
+
+
+def on_epoch_end(epoch, logs):
+    metrics.inc("epochs")
+    metrics.set_gauge("epoch.last_loss", logs["loss"])
+
+
+def run_step(x):
+    out = step(x)
+    metrics.observe_value("step.total_s", 0.01)
+    return out

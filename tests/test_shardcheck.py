@@ -28,11 +28,13 @@ BAD_AST = {
     "wrong_axis_name.py": {"SC101"},
     "rank_mismatch_spec.py": {"SC102"},
     "side_effect_in_jit.py": {"SC103"},
+    "metrics_in_jit.py": {"SC103"},
     "donated_reuse.py": {"SC104"},
     "swallowed_liveness.py": {"SC105"},
 }
 GOOD_AST = ["declared_axis.py", "matching_spec.py", "pure_jit.py",
-            "donate_rebind.py", "reraised_liveness.py"]
+            "metrics_in_callback.py", "donate_rebind.py",
+            "reraised_liveness.py"]
 
 
 def _cli_json(capsys, argv):

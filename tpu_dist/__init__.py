@@ -30,7 +30,8 @@ Reference example, ported (tf_dist_example.py:1-59):
     model.fit(dataset, epochs=10, steps_per_epoch=20)
 """
 
-from tpu_dist import cluster, data, models, ops, parallel, training, utils
+from tpu_dist import (cluster, data, models, observe, ops, parallel,
+                      training, utils)
 from tpu_dist.cluster import ClusterConfig, barrier, initialize, is_chief
 from tpu_dist.data import AutoShardPolicy, Dataset, Options
 from tpu_dist.models import Model, Sequential, build_and_compile_cnn_model
@@ -58,7 +59,8 @@ from tpu_dist.training import (
 __version__ = "0.1.0"
 
 __all__ = [
-    "cluster", "data", "models", "ops", "parallel", "training", "utils",
+    "cluster", "data", "models", "observe", "ops", "parallel", "training",
+    "utils",
     "ClusterConfig", "barrier", "initialize", "is_chief",
     "AutoShardPolicy", "Dataset", "Options",
     "Model", "Sequential", "build_and_compile_cnn_model",

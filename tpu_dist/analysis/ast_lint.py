@@ -11,8 +11,11 @@ mistake classes that compile fine and fail only on the machine:
   places (``device_put`` / ``with_sharding_constraint`` with an inline
   spec over an array whose constructor shape is visible).
 * **SC103** — host side effects (``print``, ``time.time``, stdlib
-  ``random``, ``input``/``breakpoint``) inside jitted functions: they run
-  once at trace time, not per step.
+  ``random``, ``input``/``breakpoint``, and ``tpu_dist.observe`` metric
+  recording) inside jitted functions: they run once at trace time, not per
+  step. Pure observe reads (``enabled``, ``get_registry``, ``quantile``,
+  ``active_step_timer``) are allowlisted — the same calls from eager
+  callbacks are always fine.
 * **SC104** — reads of a buffer after it was donated to a
   ``jit(donate_argnums=...)`` call in the same scope.
 * **SC105** — broad ``except Exception`` / bare ``except`` handlers around
@@ -64,6 +67,14 @@ _ARRAY_CTOR_SHAPE_POS = {
 
 _TIME_EFFECTS = {"time.time", "time.perf_counter", "time.monotonic",
                  "time.time_ns", "time.perf_counter_ns"}
+
+#: tpu_dist.observe call tails SC103 does NOT flag inside jitted code:
+#: pure reads with no recording side effect. Everything else under the
+#: observe namespace (inc, observe_value, set_gauge, instrument methods
+#: reached through module paths) mutates host state and gets flagged —
+#: metric recording belongs in callbacks and the eager fit loop.
+_OBSERVE_JIT_SAFE = {"enabled", "get_registry", "active_step_timer",
+                     "quantile"}
 
 #: Call tails whose failure semantics include PeerUnavailableError — the
 #: liveness verdict surface (cluster/liveness.py) and the host-level
@@ -377,6 +388,12 @@ class _FileLint(ast.NodeVisitor):
                 elif dotted.startswith("random."):
                     effect = (f"{dotted}() (Python-level randomness is "
                               "baked in at trace time; use jax.random)")
+                elif (dotted.startswith("tpu_dist.observe")
+                      and dotted.rsplit(".", 1)[-1]
+                      not in _OBSERVE_JIT_SAFE):
+                    effect = (f"{dotted}() (metric recording is a host "
+                              "side effect; record from a callback or "
+                              "the eager fit loop)")
                 if effect is not None:
                     self._flag(
                         "SC103", node,

@@ -228,11 +228,44 @@ def _trace_resilience_demo_step():
     return jax.make_jaxpr(step)(*state, x, y, rng)
 
 
+def _trace_observe_demo_step():
+    """The demo step exactly as ``python -m tpu_dist.observe demo`` runs it:
+    telemetry armed — registry enabled, collective observe hook installed —
+    while the program traces. Pins that observe instrumentation stays on
+    the host side of the seam: hook firings at trace time must not add or
+    reorder collectives in the program XLA partitions."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.models.cnn import build_and_compile_cnn_model
+    from tpu_dist.observe.metrics import MetricsRegistry
+    from tpu_dist.observe.telemetry import registry_collective_hook
+    from tpu_dist.parallel import collectives
+    from tpu_dist.training.trainer import Trainer
+
+    registry = MetricsRegistry(enabled=True)
+    prev = collectives.install_observe_hook(
+        registry_collective_hook(registry))
+    try:
+        model = build_and_compile_cnn_model(learning_rate=0.01)
+        trainer = Trainer(model)
+        step = trainer._pure_step()
+        trainer.ensure_variables()
+        state = trainer.train_state()
+        x = np.zeros((8, 28, 28, 1), np.float32)
+        y = np.zeros((8,), np.int32)
+        rng = jax.random.PRNGKey(0)
+        return jax.make_jaxpr(step)(*state, x, y, rng)
+    finally:
+        collectives.install_observe_hook(prev)
+
+
 ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
     "training.trainer.train_step": _trace_train_step,
     "resilience.entrypoints.demo_train_step": _trace_resilience_demo_step,
+    "observe.demo_train_step": _trace_observe_demo_step,
 }
 
 

@@ -198,14 +198,20 @@ def barrier(name: str = "tpu_dist_barrier") -> None:
     before health checking starts (tf:...collective_all_reduce_strategy.py:
     1043-1066, SURVEY.md §5.3).
     """
+    import time
+
     import jax
 
-    from tpu_dist.parallel.collectives import fire_fault_hook
+    from tpu_dist.parallel.collectives import (fire_fault_hook,
+                                               fire_observe_hook)
 
     # Chaos seam first: a single-process run has no peers to rendezvous
     # with, but an injected barrier stall must still be injectable there.
     fire_fault_hook("barrier")
-    if jax.process_count() == 1:
-        return
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    t0 = time.perf_counter()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+    # Barrier wait time is the cluster's skew made visible — the telemetry
+    # hook records it like any other host collective (tpu_dist.observe).
+    fire_observe_hook("barrier", seconds=time.perf_counter() - t0)

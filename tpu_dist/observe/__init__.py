@@ -1,0 +1,62 @@
+"""tpu_dist.observe — metrics, collective telemetry, straggler detection.
+
+The observability subsystem the reference stack never had (its surface was
+the chief's TensorBoard duty, SURVEY.md §5.1). Four layers, one per module:
+
+* :mod:`~tpu_dist.observe.metrics` — a low-overhead in-process registry
+  (counters, gauges, reservoir-sampled distributions with p50/p95/p99);
+  free when disabled, host-side only.
+* :mod:`~tpu_dist.observe.telemetry` — the :class:`Telemetry` fit callback
+  wiring the registry to the trainer's step-phase timers and the collective
+  observe-hook seam in ``parallel/collectives.py``; armable via
+  ``$TPU_DIST_OBSERVE_DIR`` (the Supervisor does this for chaos workers).
+* :mod:`~tpu_dist.observe.straggler` — per-rank step-time comparison on
+  the chief (median-multiple threshold) plus a heartbeat monitor; verdicts
+  land in the resilience event log as ``straggler_detected``.
+* :mod:`~tpu_dist.observe.exporters` — schema-versioned JSONL time-series
+  and Prometheus textfiles.
+
+``python -m tpu_dist.observe`` (:mod:`~tpu_dist.observe.cli`) runs the demo
+workload instrumented, summarizes/asserts on a series, diffs against a
+baseline, and benchmarks telemetry overhead (``BENCH_OBSERVE.json``).
+
+Only the dependency-light metric/exporter/straggler halves import eagerly;
+Telemetry and the CLI pull in the training stack lazily via ``__getattr__``
+so ``from tpu_dist.observe import metrics`` stays cheap everywhere.
+"""
+
+from tpu_dist.observe.exporters import (SCHEMA, JsonlExporter, SchemaError,
+                                        read_series,
+                                        write_prometheus_textfile)
+from tpu_dist.observe.metrics import (MetricsRegistry, disable, enable,
+                                      enabled, get_registry, inc,
+                                      observe_value, set_gauge)
+from tpu_dist.observe.straggler import (HeartbeatMonitor, StragglerVerdict,
+                                        detect_stragglers)
+
+__all__ = [
+    "SCHEMA", "JsonlExporter", "SchemaError", "read_series",
+    "write_prometheus_textfile",
+    "MetricsRegistry", "disable", "enable", "enabled", "get_registry",
+    "inc", "observe_value", "set_gauge",
+    "HeartbeatMonitor", "StragglerVerdict", "detect_stragglers",
+    "OBSERVE_DIR_ENV", "StepTimer", "Telemetry", "active_step_timer",
+    "maybe_telemetry_from_env",
+]
+
+_LAZY = {
+    "OBSERVE_DIR_ENV": "tpu_dist.observe.telemetry",
+    "StepTimer": "tpu_dist.observe.telemetry",
+    "Telemetry": "tpu_dist.observe.telemetry",
+    "active_step_timer": "tpu_dist.observe.telemetry",
+    "maybe_telemetry_from_env": "tpu_dist.observe.telemetry",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -23,10 +23,12 @@ from __future__ import annotations
 
 import enum
 import logging
+import time
 from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 logger = logging.getLogger("tpu_dist.collectives")
 
@@ -75,6 +77,70 @@ def fire_fault_hook(op: str) -> None:
     except ImportError:  # pragma: no cover - older/newer jax layout
         pass
     hook(op)
+
+
+#: Telemetry seam (tpu_dist.observe), sibling of the fault hook above: when
+#: installed, every wrapper reports (op, phase, payload size, host wall time)
+#: AFTER doing the real work. Unlike the fault hook it also fires at trace
+#: time — tagged phase="trace" — so compile-time wrapper activity is
+#: countable without being mistaken for steady-state traffic. None in
+#: production — one pointer check per call.
+_OBSERVE_HOOK = None
+
+
+def install_observe_hook(hook):
+    """Install (or, with None, remove) the collective observe hook.
+
+    ``hook(op, *, phase, leaves, nbytes, seconds)`` is called after each
+    wrapper in this module (and bootstrap.barrier): ``phase`` is "eager" or
+    "trace", ``leaves``/``nbytes`` describe the payload pytree (0 when not
+    applicable), ``seconds`` is host wall time for host-level collectives
+    (None for in-program ones). Returns the previously installed hook so
+    callers can restore it.
+    """
+    global _OBSERVE_HOOK
+    prev = _OBSERVE_HOOK
+    _OBSERVE_HOOK = hook
+    return prev
+
+
+def _tree_payload(tree: Any) -> tuple[int, int]:
+    """(leaf count, total payload bytes) of a pytree — works on tracers,
+    whose aval still carries size/dtype. Opaque leaves count as 0 bytes."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    total = 0
+    for leaf in leaves:
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is not None and dtype is not None:
+            try:
+                total += int(size) * np.dtype(dtype).itemsize
+            except TypeError:
+                pass
+    return len(leaves), total
+
+
+def fire_observe_hook(op: str, tree: Any = None, *,
+                      seconds: "float | None" = None) -> None:
+    """Report one collective call to the installed observe hook. A hook
+    failure is logged and swallowed — telemetry must never take down the
+    collective it is watching."""
+    hook = _OBSERVE_HOOK
+    if hook is None:
+        return
+    phase = "eager"
+    try:
+        from jax.core import trace_state_clean
+
+        if not trace_state_clean():
+            phase = "trace"
+    except ImportError:  # pragma: no cover - older/newer jax layout
+        pass
+    leaves, nbytes = (0, 0) if tree is None else _tree_payload(tree)
+    try:
+        hook(op, phase=phase, leaves=leaves, nbytes=nbytes, seconds=seconds)
+    except Exception:  # noqa: BLE001 - observability is best-effort
+        logger.debug("observe hook failed for %s", op, exc_info=True)
 
 
 class CollectiveCommunication(enum.Enum):
@@ -149,6 +215,7 @@ def all_reduce(tree: Any, axis: str, op: ReduceOp | str = ReduceOp.MEAN) -> Any:
     """
     op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
     fire_fault_hook("all_reduce")
+    fire_observe_hook("all_reduce", tree)
     _log_tree(f"all_reduce[{op.value}]", tree, axis)
     if op is ReduceOp.SUM:
         return jax.lax.psum(tree, axis)
@@ -164,6 +231,7 @@ def all_reduce(tree: Any, axis: str, op: ReduceOp | str = ReduceOp.MEAN) -> Any:
 def all_gather(x: Any, axis: str, *, tiled: bool = False) -> Any:
     """Gather values across a mesh axis (per-replica -> global view)."""
     fire_fault_hook("all_gather")
+    fire_observe_hook("all_gather", x)
     _log_tree("all_gather", x, axis)
     return jax.lax.all_gather(x, axis, tiled=tiled)
 
@@ -176,19 +244,53 @@ def host_all_reduce_sum(x) -> Any:
     reduction (keras trainer reduce_per_replica, SURVEY.md D15).
     """
     fire_fault_hook("host_all_reduce_sum")
+    t0 = time.perf_counter()
     if jax.process_count() == 1:
-        return x
-    from jax.experimental import multihost_utils
+        out = x
+    else:
+        from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(jnp.asarray(x)).sum(axis=0)
+        out = multihost_utils.process_allgather(jnp.asarray(x)).sum(axis=0)
+    fire_observe_hook("host_all_reduce_sum", out,
+                      seconds=time.perf_counter() - t0)
+    return out
+
+
+def host_all_gather(x) -> Any:
+    """Host-level gather across processes: every process's value stacked on
+    a new leading axis, ``[process_count, ...]``, identical everywhere.
+
+    The telemetry exchange primitive: each rank contributes its local
+    measurement (e.g. this epoch's mean step time) and the chief — like
+    every other rank — sees the full per-rank vector
+    (observe/telemetry.py straggler detection). Single-process runs return
+    ``np.asarray(x)[None]`` so callers never branch on process count.
+    """
+    fire_fault_hook("host_all_gather")
+    t0 = time.perf_counter()
+    if jax.process_count() == 1:
+        out = np.asarray(x)[None]
+    else:
+        from jax.experimental import multihost_utils
+
+        out = np.asarray(
+            multihost_utils.process_allgather(jnp.asarray(x)))
+    fire_observe_hook("host_all_gather", out,
+                      seconds=time.perf_counter() - t0)
+    return out
 
 
 def broadcast_from_chief(tree: Any) -> Any:
     """Broadcast process 0's pytree to all processes (host-level, D4 init
     broadcast / checkpoint-restore fan-out)."""
     fire_fault_hook("broadcast_from_chief")
+    t0 = time.perf_counter()
     if jax.process_count() == 1:
-        return tree
-    from jax.experimental import multihost_utils
+        out = tree
+    else:
+        from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(tree)
+        out = multihost_utils.broadcast_one_to_all(tree)
+    fire_observe_hook("broadcast_from_chief", out,
+                      seconds=time.perf_counter() - t0)
+    return out

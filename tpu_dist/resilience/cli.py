@@ -60,11 +60,14 @@ def _worker_cmd() -> list:
 
 
 def _clean_env(extra: dict) -> dict:
-    """os.environ minus any resilience wiring from OUR caller, plus
+    """os.environ minus any resilience/observe wiring from OUR caller, plus
     ``extra`` — each run (baseline, chaos) gets exactly its own knobs."""
+    from tpu_dist.observe.telemetry import OBSERVE_DIR_ENV
+
     env = {k: v for k, v in os.environ.items()
            if k not in (FAULT_PLAN_ENV, events.EVENT_LOG_ENV,
-                        events.ATTEMPT_ENV, CHECKPOINT_DIR_ENV)}
+                        events.ATTEMPT_ENV, CHECKPOINT_DIR_ENV,
+                        OBSERVE_DIR_ENV)}
     env.update(extra)
     return env
 
@@ -150,7 +153,8 @@ def main(argv: Optional[list] = None) -> int:
         max_restarts=args.max_restarts, attempt_deadline_s=args.deadline,
         backoff=BackoffPolicy(initial_s=args.backoff),
         env=_clean_env(extra_env), log_dir=workdir / "logs",
-        event_log=events.EventLog(event_path, role="supervisor"))
+        event_log=events.EventLog(event_path, role="supervisor"),
+        observe_dir=workdir / "observe")
     sup_report = sup.run()
 
     final = None
@@ -173,6 +177,25 @@ def main(argv: Optional[list] = None) -> int:
              if r.get(k) is not None} for r in fired],
         "events": len(events.read_events(event_path)),
         "final_loss": (final or {}).get("final_loss"),
+    }
+    # Per-rank telemetry (the workers run with TPU_DIST_OBSERVE_DIR armed,
+    # so their Telemetry callbacks emit step_timing/straggler_detected into
+    # the shared event log).
+    timing = events.read_events(event_path, "step_timing")
+    per_rank: dict = {}
+    for rec in timing:
+        per_rank.setdefault(int(rec.get("rank", 0)), []).append(
+            float(rec.get("mean_step_s", 0.0)))
+    report["telemetry"] = {
+        "observe_dir": str(workdir / "observe"),
+        "step_timing_events": len(timing),
+        "per_rank_mean_step_s": {
+            str(rank): round(sum(v) / len(v), 6)
+            for rank, v in sorted(per_rank.items()) if v},
+        "stragglers": [
+            {k: rec.get(k) for k in ("epoch", "rank", "step_s",
+                                     "median_s", "ratio")}
+            for rec in events.read_events(event_path, "straggler_detected")],
     }
     ok = sup_report.success and bool(fired)
     if not fired:

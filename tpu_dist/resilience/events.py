@@ -10,7 +10,10 @@ file that every participant in a chaos run appends to —
 * the :class:`~tpu_dist.resilience.supervisor.Supervisor` logs
   ``attempt_start`` / ``worker_exit`` / ``restart`` / ``recovered`` /
   ``run_complete``;
-* ``Trainer.fit`` logs ``checkpoint_resume`` when it restores state.
+* ``Trainer.fit`` logs ``checkpoint_resume`` when it restores state;
+* the :class:`~tpu_dist.observe.telemetry.Telemetry` callback logs
+  ``step_timing`` per (rank, epoch) and ``straggler_detected`` when the
+  chief flags a slow rank.
 
 Every event carries a wall-clock timestamp, the writer's role, rank and
 restart attempt, so a post-mortem can interleave supervisor- and worker-side

@@ -135,6 +135,12 @@ class Supervisor:
     per-rank ``TF_CONFIG`` (only when ``num_workers > 1``),
     ``TPU_DIST_RESILIENCE_ATTEMPT``, and whatever the caller passes in
     ``env``.
+
+    ``observe_dir`` arms per-worker telemetry: each rank gets
+    ``TPU_DIST_OBSERVE_DIR=<observe_dir>/rank<r>`` so its ``fit`` attaches
+    a :class:`~tpu_dist.observe.telemetry.Telemetry` callback, and its
+    ``step_timing``/``straggler_detected`` records land in the shared
+    event log (exports append across restarts — one series per rank).
     """
 
     def __init__(self, cmd: Sequence[str], *, num_workers: int = 1,
@@ -143,7 +149,8 @@ class Supervisor:
                  backoff: BackoffPolicy = BackoffPolicy(),
                  env: Optional[dict] = None,
                  log_dir: str | os.PathLike = "resilience-logs",
-                 event_log: Optional[events.EventLog] = None):
+                 event_log: Optional[events.EventLog] = None,
+                 observe_dir: Optional[str | os.PathLike] = None):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         if max_restarts < 0:
@@ -156,6 +163,8 @@ class Supervisor:
         self.env = dict(env or {})
         self.log_dir = pathlib.Path(log_dir)
         self.events = event_log
+        self.observe_dir = (pathlib.Path(observe_dir)
+                            if observe_dir is not None else None)
 
     # -- launching -----------------------------------------------------------
 
@@ -163,6 +172,10 @@ class Supervisor:
         env = dict(os.environ)
         env.update(self.env)
         env[events.ATTEMPT_ENV] = str(attempt)
+        if self.observe_dir is not None:
+            from tpu_dist.observe.telemetry import OBSERVE_DIR_ENV
+
+            env[OBSERVE_DIR_ENV] = str(self.observe_dir / f"rank{rank}")
         if self.num_workers > 1:
             from tpu_dist.cluster.config import make_local_cluster
 

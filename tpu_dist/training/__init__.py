@@ -22,6 +22,18 @@ __all__ = [
     "LambdaCallback",
     "ModelCheckpoint",
     "StopTraining",
+    "Telemetry",
     "TensorBoard",
     "Trainer",
 ]
+
+
+def __getattr__(name):
+    # Telemetry lives in tpu_dist.observe (which imports Callback from
+    # this package's callbacks module) — lazy re-export avoids the cycle
+    # while keeping it discoverable next to the other fit callbacks.
+    if name == "Telemetry":
+        from tpu_dist.observe.telemetry import Telemetry
+
+        return Telemetry
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -256,3 +256,15 @@ class LambdaCallback(Callback):
     def on_batch_end(self, step, logs):
         if self._batch_end:
             self._batch_end(step, logs)
+
+
+def __getattr__(name):
+    # Telemetry lives in tpu_dist.observe (which imports Callback from this
+    # module) but belongs on the callback surface alongside JSONLogger and
+    # TensorBoard; a PEP 562 lazy re-export gives it the natural spelling
+    # without the import cycle.
+    if name == "Telemetry":
+        from tpu_dist.observe.telemetry import Telemetry
+
+        return Telemetry
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -523,6 +523,17 @@ class Trainer:
             steps_per_epoch=steps_per_epoch)
         if fault_injector is not None:
             callbacks.append(fault_injector)
+        # Same env-armed pattern for telemetry (tpu_dist.observe): an
+        # observe dir in $TPU_DIST_OBSERVE_DIR — set by the Supervisor for
+        # chaos workers, or by a shell — attaches the Telemetry callback.
+        # Skipped when the caller already passed one (theirs wins).
+        from tpu_dist.observe.telemetry import (Telemetry,
+                                                maybe_telemetry_from_env)
+
+        if not any(isinstance(cb, Telemetry) for cb in callbacks):
+            telemetry = maybe_telemetry_from_env()
+            if telemetry is not None:
+                callbacks.append(telemetry)
         if checkpoint_dir is not None:
             # SURVEY.md §5.4: fit(checkpoint_dir=) = chief-writes-per-epoch +
             # resume-from-latest. A restored step N means epoch N finished.
@@ -594,9 +605,14 @@ class Trainer:
     def _run_epochs(self, dist, cbs, initial_epoch, epochs, steps_per_epoch,
                     show, root_key, val_dist=None, val_steps=None):
         from tpu_dist.data.device import DeviceDataset
+        from tpu_dist.observe.telemetry import active_step_timer
 
         device_ds = isinstance(dist, DeviceDataset)
         monitor = getattr(self.strategy, "liveness_monitor", None)
+        # Installed by a Telemetry callback's on_train_begin (which has
+        # already run); None on uninstrumented fits — the hot loop then
+        # pays exactly one is-None check per execution.
+        timer = active_step_timer()
         for epoch in range(initial_epoch, epochs):
             if monitor is not None:
                 # Surface a dead peer as a restartable error instead of letting
@@ -637,6 +653,12 @@ class Trainer:
             executions = 0
             while step_i < steps_per_epoch:
                 kk = min(k, steps_per_epoch - step_i)
+                # Step-phase timing (tpu_dist.observe): data-wait ends at
+                # t_fetch, dispatch at the compiled call's return, device
+                # time is the block_until_ready below. perf_counter calls
+                # only when a Telemetry span is active.
+                t_exec0 = time.perf_counter() if timer is not None else 0.0
+                t_fetch = t_exec0
                 with profiler.step_annotation(epoch * steps_per_epoch + step_i):
                     if kk == 1:
                         if device_ds:
@@ -650,6 +672,8 @@ class Trainer:
                         else:
                             xb, yb = self._next_batch(dist)
                         rng = key_chunks[executions]
+                        if timer is not None:
+                            t_fetch = time.perf_counter()
                         (loss, v["params"], v["state"], v["opt"], v["metrics"],
                          loss_acc) = self._train_step(
                             v["params"], v["state"], v["opt"], v["metrics"],
@@ -658,6 +682,8 @@ class Trainer:
                         # Device-resident path: batches gathered ON device
                         # (index transfer only), one scanned dispatch.
                         xb, yb = dist.next_stack(kk)
+                        if timer is not None:
+                            t_fetch = time.perf_counter()
                         (loss, v["params"], v["state"], v["opt"],
                          v["metrics"], loss_acc) = self._multi_step(
                             v["params"], v["state"], v["opt"],
@@ -669,6 +695,10 @@ class Trainer:
                         # hard-part #5). loss comes back as the kk-mean.
                         batches = [self._next_batch(dist, host=True)
                                    for _ in range(kk)]
+                        if timer is not None:
+                            # Host-iterator pulls are the data wait; the
+                            # stack/placement below is charged to dispatch.
+                            t_fetch = time.perf_counter()
                         if len({b[0].shape for b in batches}) == 1:
                             xs = np.stack([b[0] for b in batches])
                             ys = np.stack([b[1] for b in batches])
@@ -692,7 +722,16 @@ class Trainer:
                                     key_chunks[executions][j])
                 step_i += kk
                 executions += 1
-                if bounded:
+                if timer is not None:
+                    # The blocking wait IS the device-time measurement; it
+                    # also satisfies the bounded-dispatch requirement.
+                    t_disp = time.perf_counter()
+                    jax.block_until_ready(loss)
+                    timer.record_execution(
+                        steps=kk, data_wait_s=t_fetch - t_exec0,
+                        dispatch_s=t_disp - t_fetch,
+                        device_block_s=time.perf_counter() - t_disp)
+                elif bounded:
                     jax.block_until_ready(loss)
                 if eager_loss:
                     loss_val = float(loss)

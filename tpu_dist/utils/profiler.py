@@ -13,6 +13,7 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
+import time
 from typing import Iterator, Optional
 
 logger = logging.getLogger("tpu_dist.profiler")
@@ -20,6 +21,17 @@ logger = logging.getLogger("tpu_dist.profiler")
 #: True while a trace span is open in this process — lets hot loops skip
 #: annotation overhead entirely when nothing is recording.
 _ACTIVE = False
+
+
+def _observe_registry():
+    """The tpu_dist.observe default registry, or None when the observe
+    package is unavailable/unloadable — profiling must work without it."""
+    try:
+        from tpu_dist.observe import metrics
+
+        return metrics.get_registry()
+    except Exception:  # noqa: BLE001 - diagnostics only
+        return None
 
 
 def is_active() -> bool:
@@ -46,6 +58,9 @@ def trace(logdir: str | os.PathLike, *, chief_only: bool = True) -> Iterator[Non
     os.makedirs(logdir, exist_ok=True)
     jax.profiler.start_trace(logdir)
     _ACTIVE = True
+    reg = _observe_registry()
+    if reg is not None and reg.enabled:
+        reg.counter("profiler.traces").inc()
     logger.info("profiler trace started -> %s", logdir)
     try:
         yield
@@ -68,8 +83,20 @@ def step_annotation(step: int):
 
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
-    """Named trace span (host-side), e.g. around input pipeline sections."""
+    """Named trace span (host-side), e.g. around input pipeline sections.
+
+    Doubles as a metric emitter: when the tpu_dist.observe registry is
+    enabled, the span's wall time is recorded as the ``span.<name>.s``
+    distribution — so an annotated section shows up in metrics exports
+    even when no profiler trace is being captured."""
     import jax
 
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        reg = _observe_registry()
+        if reg is not None and reg.enabled:
+            reg.distribution(f"span.{name}.s").observe(
+                time.perf_counter() - t0)
