@@ -4,19 +4,30 @@
 #
 # Usage: scripts/check.sh
 #
-# Step 1 runs `python -m tpu_dist.analysis` over the package and fails on
-# any error-severity finding (the dogfooded self-check — see README.md
-# "Static analysis"). Step 2 is the supervised kill/restart/resume demo
-# (README.md "Fault tolerance & chaos testing"). Step 3 benchmarks the
-# telemetry overhead and gates the instrumented series for non-vacuity
-# (README.md "Observability"; writes BENCH_OBSERVE.json). Step 4 is the
-# tier-1 pytest command from ROADMAP.md.
+# Step 1 runs `python -m tpu_dist.analysis` over the package and examples
+# and fails on any error-severity finding (the dogfooded self-check — see
+# README.md "Static analysis"). Step 2 diffs the static communication/
+# memory cost model against the committed ANALYSIS_BASELINE.json (SC301
+# comm regression past the baseline's tolerance fails; re-run with
+# --update-baseline and commit the diff for intended growth). Step 3 is
+# the supervised kill/restart/resume demo (README.md "Fault tolerance &
+# chaos testing"). Step 4 benchmarks the telemetry overhead and gates the
+# instrumented series for non-vacuity (README.md "Observability"; writes
+# BENCH_OBSERVE.json). Step 5 is the tier-1 pytest command from
+# ROADMAP.md.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 echo "== shardcheck: static sharding/collective analysis =="
-JAX_PLATFORMS=cpu python -m tpu_dist.analysis tpu_dist/ --fail-on error \
+JAX_PLATFORMS=cpu python -m tpu_dist.analysis tpu_dist/ examples/ \
+  --fail-on error \
   || { echo "check.sh: shardcheck found error-severity findings" >&2; exit 1; }
+
+echo "== analysis-cost: communication/memory budget vs baseline =="
+JAX_PLATFORMS=cpu python -m tpu_dist.analysis cost \
+  --baseline ANALYSIS_BASELINE.json \
+  || { echo "check.sh: cost model regressed past ANALYSIS_BASELINE.json" \
+       "(intended? re-run with --update-baseline and commit)" >&2; exit 1; }
 
 echo "== resilience-smoke: supervised kill/restart/resume chaos run =="
 # The acceptance demo from README.md "Fault tolerance & chaos testing":

@@ -1,0 +1,16 @@
+"""shardcheck bad fixture: a 2 MiB argument dead after one use, never
+donated (SC303). The jaxpr proves ``big`` is read exactly once (the
+scale), so ``jit(donate_argnums=(0,))`` would alias the input buffer into
+the output and halve the footprint — see good/donated_large_arg.py for
+the fixed spelling via the 3-tuple entry protocol.
+"""
+
+import jax.numpy as jnp
+
+
+def _scale(big, lr):
+    return big * lr
+
+
+def shardcheck_entry():
+    return _scale, (jnp.zeros((512, 1024), jnp.float32), 0.5)

@@ -1,0 +1,14 @@
+"""shardcheck good fixture: the same dead-after-one-use 2 MiB argument as
+bad/undonated_large_arg.py, but the entry declares it donated — the
+3-tuple ``shardcheck_entry`` protocol ``(fn, args, donate_argnums)``
+tells SC303 the production caller already aliases it away."""
+
+import jax.numpy as jnp
+
+
+def _scale(big, lr):
+    return big * lr
+
+
+def shardcheck_entry():
+    return _scale, (jnp.zeros((512, 1024), jnp.float32), 0.5), (0,)

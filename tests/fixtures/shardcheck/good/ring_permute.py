@@ -1,0 +1,27 @@
+"""shardcheck good fixture: a well-formed ring ppermute (SC203 clean).
+Indices in range, every source and destination unique — the neighbor
+exchange both pipeline schedules are built on."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+AXIS = "data"
+
+
+def _rotate(x):
+    return jax.lax.ppermute(x, AXIS, [(0, 1), (1, 0)])
+
+
+def shardcheck_entry():
+    from tpu_dist.parallel import mesh as mesh_lib
+
+    devices = jax.devices()[:2]
+    mesh = Mesh(devices, (AXIS,))
+    shard_map = mesh_lib.get_shard_map()
+    kw = dict(mesh=mesh, in_specs=(P(),), out_specs=P())
+    try:
+        mapped = shard_map(_rotate, check_vma=False, **kw)
+    except TypeError:
+        mapped = shard_map(_rotate, check_rep=False, **kw)
+    return mapped, (jnp.ones((4,)),)

@@ -7,24 +7,49 @@ jitted step functions, where a mistake compiles fine and corrupts training
 or deadlocks at run time. This subsystem catches those mistakes before a
 TPU-hour is spent:
 
-* :mod:`~tpu_dist.analysis.ast_lint` — source-level rules SC101-SC104
+* :mod:`~tpu_dist.analysis.ast_lint` — source-level rules SC101-SC105
   (unknown collective axis, PartitionSpec/rank mismatch, host side effects
-  under jit, donated-buffer reuse);
-* :mod:`~tpu_dist.analysis.jaxpr_checks` — rule SC201 (collective-order
-  divergence across cond/switch branches) over CPU-traced entry points;
+  under jit, donated-buffer reuse, swallowed liveness errors);
+* :mod:`~tpu_dist.analysis.jaxpr_checks` — interprocedural jaxpr rules
+  over CPU-traced entry points: SC201 (collective-order divergence across
+  cond/switch branches), SC202 (collectives under a data-dependent while),
+  SC203 (payload/permutation mismatches), SC303 (undonated dead args);
+* :mod:`~tpu_dist.analysis.costmodel` / :mod:`~tpu_dist.analysis.baseline`
+  — the static communication-volume and peak-HBM model over the same
+  traces, and the committed-baseline diff behind SC301/SC302
+  (``ANALYSIS_BASELINE.json``, the ``analysis-cost`` CI stage);
 * :mod:`~tpu_dist.analysis.rules` / :mod:`~tpu_dist.analysis.report` —
-  the rule catalogue, suppressions, JSON/text output, exit-code policy;
-* :mod:`~tpu_dist.analysis.cli` — ``python -m tpu_dist.analysis [paths]``.
+  the rule catalogue, suppressions, text/JSON/GitHub-annotation output,
+  exit-code policy;
+* :mod:`~tpu_dist.analysis.cli` — ``python -m tpu_dist.analysis [paths]``
+  and ``python -m tpu_dist.analysis cost``.
 
 See README.md "Static analysis" for the CLI and rule catalogue;
-``scripts/check.sh`` wires the checker in front of the tier-1 test gate.
+``scripts/check.sh`` wires the checker and the cost gate in front of the
+tier-1 test gate.
 """
 
 from tpu_dist.analysis.ast_lint import lint_file, lint_paths
-from tpu_dist.analysis.cli import main
+from tpu_dist.analysis.baseline import (
+    DEFAULT_TOLERANCE_PCT,
+    build as build_baseline,
+    compare as compare_baseline,
+    load as load_baseline,
+)
+from tpu_dist.analysis.cli import cost_main, main
+from tpu_dist.analysis.costmodel import (
+    CollectiveCost,
+    CostReport,
+    analyze_jaxpr,
+    comm_bytes,
+    parse_mesh,
+    peak_live_bytes,
+)
 from tpu_dist.analysis.jaxpr_checks import (
     check_branch_collectives,
     check_callable,
+    check_jaxpr,
+    check_while_collectives,
     collective_sequence,
     run_entry_points,
 )
@@ -34,8 +59,13 @@ from tpu_dist.analysis.rules import RULES, Finding, Rule, Severity
 __all__ = [
     "RULES", "Finding", "Rule", "Severity",
     "lint_file", "lint_paths",
-    "check_branch_collectives", "check_callable", "collective_sequence",
+    "check_branch_collectives", "check_callable", "check_jaxpr",
+    "check_while_collectives", "collective_sequence",
     "run_entry_points",
+    "CollectiveCost", "CostReport", "analyze_jaxpr", "comm_bytes",
+    "parse_mesh", "peak_live_bytes",
+    "DEFAULT_TOLERANCE_PCT", "build_baseline", "compare_baseline",
+    "load_baseline",
     "exit_code", "to_json_dict",
-    "main",
+    "main", "cost_main",
 ]

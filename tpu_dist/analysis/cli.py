@@ -1,17 +1,27 @@
-"""shardcheck CLI: ``python -m tpu_dist.analysis [paths]``.
+"""shardcheck CLI: ``python -m tpu_dist.analysis [cost] [paths]``.
 
-Two passes over the given paths (default: the installed ``tpu_dist``
-package):
+The default (check) mode runs two passes over the given paths (default:
+the installed ``tpu_dist`` package):
 
 1. the AST lint (ast_lint.py) over every ``.py`` file — no imports, no
    backend;
 2. unless ``--no-trace``: the jaxpr checks (jaxpr_checks.py) — the
-   built-in entry points (trainer step, both pipeline schedules) traced on
-   a forced-CPU backend, plus any analyzed module that defines a
-   ``shardcheck_entry()`` returning ``(fn, example_args)``.
+   built-in entry points (trainer step, both pipeline schedules, the
+   TP/SP/MoE parallel steps) traced on a forced-CPU backend, plus any
+   analyzed module that defines a ``shardcheck_entry()`` returning
+   ``(fn, example_args)`` or ``(fn, example_args, donate_argnums)``.
 
 Exit code 1 when any finding reaches ``--fail-on`` severity (default:
-error), 0 otherwise — the contract ``scripts/check.sh`` builds on.
+error; ``--strict`` lowers it to warning), 0 otherwise — the contract
+``scripts/check.sh`` builds on. ``--format github`` renders findings as
+workflow annotations (``::error file=…,line=…::``).
+
+``cost`` mode prices the same traces instead of rule-checking them: per
+entry point, modeled communication volume and peak live-buffer bytes
+(costmodel.py), optionally diffed against a committed baseline
+(baseline.py) — ``--baseline`` to gate, ``--update-baseline`` to commit
+intended growth, ``--mesh data=8,model=4`` to model a topology other
+than the traced one.
 """
 
 from __future__ import annotations
@@ -28,11 +38,12 @@ from tpu_dist.analysis.rules import Finding, apply_suppressions
 
 
 def _force_cpu_backend() -> None:
-    """Pin tracing to CPU with enough virtual devices for a 2-stage pipe
-    mesh. jax reads XLA_FLAGS at backend init and its platform config
-    lazily, so this works even though the package import already pulled in
-    jax — unless a backend was initialized first, in which case the entry
-    traces degrade to SC900 info findings on their own."""
+    """Pin tracing to CPU with enough virtual devices for the entry-point
+    meshes (the data x expert MoE entry needs 8). jax reads XLA_FLAGS at
+    backend init and its platform config lazily, so this works even though
+    the package import already pulled in jax — unless a backend was
+    initialized first, in which case the entry traces degrade to SC900
+    info findings on their own."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
@@ -58,24 +69,38 @@ def _has_shardcheck_entry(path: str) -> bool:
                for node in tree.body)
 
 
+def _load_module_entry(path: str):
+    """Import ``path`` and normalize its ``shardcheck_entry()`` to
+    ``(fn, args, donate_argnums)`` — the optional third element tells
+    SC303 which arguments the production caller donates."""
+    name = "_shardcheck_" + os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    entry = tuple(module.shardcheck_entry())
+    if len(entry) == 3:
+        fn, args, donated = entry
+    else:
+        fn, args = entry
+        donated = ()
+    return fn, tuple(args), tuple(donated)
+
+
 def _check_module_entry(path: str) -> list[Finding]:
     """Import ``path`` and run jaxpr checks on its shardcheck_entry()."""
     from tpu_dist.analysis import jaxpr_checks
 
-    name = "_shardcheck_" + os.path.splitext(
-        os.path.basename(path))[0]
     try:
-        spec = importlib.util.spec_from_file_location(name, path)
-        module = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(module)
-        fn, args = module.shardcheck_entry()
+        fn, args, donated = _load_module_entry(path)
         return jaxpr_checks.check_callable(
-            fn, tuple(args), label=f"{path}::shardcheck_entry", path=path)
+            fn, args, label=f"{path}::shardcheck_entry", path=path,
+            donated=donated)
     except Exception as e:  # noqa: BLE001 - degrade, never crash the run
+        from tpu_dist.analysis.jaxpr_checks import _cause
+
         return [Finding(
             "SC900", path, 1, 0,
-            f"shardcheck_entry() could not be traced "
-            f"({type(e).__name__}: {e})")]
+            f"shardcheck_entry() could not be traced ({_cause(e)})")]
 
 
 def _default_paths() -> list[str]:
@@ -85,18 +110,39 @@ def _default_paths() -> list[str]:
     return [os.path.dirname(os.path.abspath(tpu_dist.__file__))]
 
 
+def _render(findings, *, fmt: str, paths=(), fail_on: str) -> None:
+    if fmt == "json":
+        report.dump_json(report.to_json_dict(
+            findings, paths=paths, fail_on=fail_on))
+    elif fmt == "github":
+        report.render_github(findings)
+    else:
+        report.render_text(findings, paths=paths)
+
+
 def main(argv: Optional[list] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "cost":
+        return cost_main(argv[1:])
+
     parser = argparse.ArgumentParser(
         prog="python -m tpu_dist.analysis",
         description="shardcheck: static sharding/collective consistency "
-                    "checks for tpu_dist programs")
+                    "checks for tpu_dist programs (see also the `cost` "
+                    "subcommand for the communication/memory cost model)")
     parser.add_argument(
         "paths", nargs="*",
         help="files or directories to check (default: the tpu_dist "
              "package)")
     parser.add_argument(
         "--json", action="store_true",
-        help="machine-readable JSON on stdout instead of text")
+        help="machine-readable JSON on stdout instead of text "
+             "(alias for --format json)")
+    parser.add_argument(
+        "--format", default=None, choices=("text", "json", "github"),
+        help="output format; `github` emits ::error/::warning workflow "
+             "annotations (default: text)")
     parser.add_argument(
         "--no-trace", action="store_true",
         help="skip the jaxpr-level checks (AST lint only; no jax backend "
@@ -107,6 +153,9 @@ def main(argv: Optional[list] = None) -> int:
         help="lowest severity that makes the exit code non-zero "
              "(default: error)")
     parser.add_argument(
+        "--strict", action="store_true",
+        help="fail on warnings too (shorthand for --fail-on warning)")
+    parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalogue and exit")
     args = parser.parse_args(argv)
@@ -114,6 +163,9 @@ def main(argv: Optional[list] = None) -> int:
     if args.list_rules:
         report.render_rules()
         return 0
+
+    fmt = args.format or ("json" if args.json else "text")
+    fail_on = "warning" if args.strict else args.fail_on
 
     paths = args.paths or _default_paths()
     for p in paths:
@@ -142,12 +194,150 @@ def main(argv: Optional[list] = None) -> int:
                 source_by_path[f] = fh.read().splitlines()
         findings.extend(apply_suppressions(trace_findings, source_by_path))
 
-    if args.json:
-        report.dump_json(report.to_json_dict(
-            findings, paths=paths, fail_on=args.fail_on))
+    _render(findings, fmt=fmt, paths=paths, fail_on=fail_on)
+    return report.exit_code(findings, fail_on=fail_on)
+
+
+def cost_main(argv: Optional[list] = None) -> int:
+    """``python -m tpu_dist.analysis cost`` — the cost model + baseline
+    gate. See the module docstring for semantics; the mesh precedence is
+    ``--mesh`` > the baseline's committed mesh > the traced meshes
+    unmodified, so a bare ``cost --baseline ...`` (the check.sh stage)
+    reprices exactly the topology the baseline was committed at."""
+    from tpu_dist.analysis import baseline as baseline_lib
+    from tpu_dist.analysis import costmodel
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tpu_dist.analysis cost",
+        description="shardcheck cost model: static per-entry-point "
+                    "communication volume and peak live-buffer estimate, "
+                    "with an optional committed-baseline CI gate")
+    parser.add_argument(
+        "paths", nargs="*",
+        help="additional modules with a shardcheck_entry() to price "
+             "alongside the built-in entry points")
+    parser.add_argument(
+        "--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
+        help="model the ring costs at these axis sizes (e.g. "
+             "data=8,model=4) instead of the traced mesh sizes")
+    parser.add_argument(
+        "--entries", default=None, metavar="NAME[,NAME...]",
+        help="restrict to these built-in entry points (default: all)")
+    parser.add_argument(
+        "--baseline", default=None, metavar="PATH",
+        help="diff against this committed baseline; comm growth past the "
+             "tolerance is an SC301 error, peak HBM past budget an SC302 "
+             "warning")
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="write the measured costs to --baseline (default "
+             "ANALYSIS_BASELINE.json) instead of diffing, carrying over "
+             "still-valid HBM budgets")
+    parser.add_argument(
+        "--tolerance", type=float, default=None, metavar="PCT",
+        help="comm-growth tolerance in percent (default: the baseline's "
+             f"committed value, else {baseline_lib.DEFAULT_TOLERANCE_PCT:g})")
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable JSON on stdout instead of text "
+             "(alias for --format json)")
+    parser.add_argument(
+        "--format", default=None, choices=("text", "json", "github"),
+        help="output format (github: workflow annotations for findings)")
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="fail on warnings (SC302) too, not just SC301 errors")
+    args = parser.parse_args(argv)
+
+    fmt = args.format or ("json" if args.json else "text")
+    fail_on = "warning" if args.strict else "error"
+    baseline_path = args.baseline or "ANALYSIS_BASELINE.json"
+    for p in args.paths:
+        if not os.path.exists(p):
+            parser.error(f"no such path: {p}")
+
+    previous = None
+    if os.path.exists(baseline_path) and (args.baseline
+                                          or args.update_baseline):
+        previous = baseline_lib.load(baseline_path)
+    elif args.baseline and not args.update_baseline:
+        parser.error(f"no such baseline: {args.baseline}")
+
+    if args.mesh is not None:
+        model_mesh = costmodel.parse_mesh(args.mesh)
+    elif previous is not None and not args.update_baseline:
+        model_mesh = dict(previous.get("mesh", {}))
     else:
-        report.render_text(findings, paths=paths)
-    return report.exit_code(findings, fail_on=args.fail_on)
+        model_mesh = {}
+
+    _force_cpu_backend()
+    from tpu_dist.analysis import jaxpr_checks
+
+    names = (set(args.entries.split(",")) if args.entries else None)
+    if names:
+        # ``module:<basename>`` labels select path entries; the rest must
+        # name built-ins.
+        unknown = {n for n in names
+                   if n not in jaxpr_checks.ENTRY_POINTS
+                   and not n.startswith("module:")}
+        if unknown:
+            parser.error(f"unknown entry point(s): {sorted(unknown)}; "
+                         f"known: {sorted(jaxpr_checks.ENTRY_POINTS)} "
+                         "plus module:<basename> labels")
+    traced, findings = jaxpr_checks.trace_entry_points(names)
+    reports = {
+        name: costmodel.analyze_jaxpr(
+            closed, entry=name, model_mesh=model_mesh)
+        for name, closed in traced.items()}
+
+    for p in args.paths:
+        for f in ast_lint.iter_python_files([p]):
+            if not _has_shardcheck_entry(f):
+                continue
+            label = "module:" + os.path.splitext(os.path.basename(f))[0]
+            if names is not None and label not in names:
+                continue
+            try:
+                fn, fargs, _ = _load_module_entry(f)
+                import jax
+
+                closed = jax.make_jaxpr(fn)(*fargs)
+                reports[label] = costmodel.analyze_jaxpr(
+                    closed, entry=label, model_mesh=model_mesh)
+            except Exception as e:  # noqa: BLE001 - degrade, never crash
+                findings.append(Finding(
+                    "SC900", f, 1, 0,
+                    f"shardcheck_entry() could not be traced "
+                    f"({jaxpr_checks._cause(e)})"))
+
+    if args.update_baseline:
+        tol = (args.tolerance if args.tolerance is not None
+               else (previous or {}).get(
+                   "tolerance_pct", baseline_lib.DEFAULT_TOLERANCE_PCT))
+        data = baseline_lib.build(
+            reports, mesh=model_mesh, tolerance_pct=tol, previous=previous)
+        baseline_lib.write(baseline_path, data)
+        print(f"wrote {baseline_path}: {len(reports)} entry point(s), "
+              f"mesh {model_mesh or '(as traced)'}, "
+              f"tolerance {float(tol):g}%")
+        for f in report.sort_findings(findings):
+            print(f.render())
+        return 0
+
+    if previous is not None:
+        findings.extend(baseline_lib.compare(
+            reports, previous, tolerance_pct=args.tolerance,
+            path=baseline_path))
+
+    if fmt == "json":
+        report.dump_json(report.to_cost_json(
+            reports, findings, mesh=model_mesh,
+            baseline_path=args.baseline, fail_on=fail_on))
+    elif fmt == "github":
+        report.render_github(findings)
+    else:
+        report.render_cost_text(reports, findings, mesh=model_mesh)
+    return report.exit_code(findings, fail_on=fail_on)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -1,0 +1,382 @@
+"""Static communication/memory cost model over traced jaxprs.
+
+The jaxpr rules (SC2xx) answer "can this program deadlock?"; this module
+answers "how much does it communicate, and how much does it hold live?" —
+the two quantities whose regressions only surface as step-time cliffs and
+OOMs at pod scale. Both are computed from the same CPU ``make_jaxpr``
+traces the SC2xx pass already produces; nothing compiles, nothing runs.
+
+**Communication volume.** Every collective eqn contributes
+``bytes_on_wire = formula(P, payload_bytes) * multiplier`` where
+
+* ``payload_bytes`` is the operand aval's size — inside ``shard_map`` the
+  trace already sees per-device shard shapes, i.e. the global aval divided
+  by the ``in_specs``-sharded axis sizes;
+* ``P`` is the participant count of the collective's mesh axes — taken
+  from the enclosing ``shard_map``'s mesh, overridable per axis with a
+  modeled mesh (``--mesh data=8,model=4``) so one trace prices many
+  topologies. Payload shapes stay as traced; only the ring arithmetic
+  rescales;
+* the formula is the standard ring cost per device: all-reduce (psum/
+  pmax/pmin) ``2*(P-1)/P``, all_gather ``(P-1)`` (of the per-shard
+  input), reduce_scatter/all_to_all ``(P-1)/P``, ppermute ``1`` (one
+  neighbor send). ``pbroadcast``/``pvary`` are the replication-type casts
+  jax's check_rep/check_vma rewriter inserts — no bytes move — and cost 0;
+* the ``multiplier`` folds in control flow: a collective inside a
+  ``lax.scan`` of length L launches L times; ``cond``/``switch`` branches
+  are all counted (a deliberate conservative over-count — branch
+  probabilities are not static knowledge); a ``while`` body counts once
+  (its trip count is data-dependent, which SC202 flags as a deadlock risk
+  anyway).
+
+**Peak live bytes (HBM estimate).** A linear scan over each jaxpr's eqns:
+a value is born at its defining eqn and dies after its last use; the peak
+of the running live-byte sum estimates per-rank HBM pressure. Sub-jaxprs
+(scan/cond/while bodies, pjit calls, remat) contribute their own internal
+peak minus their boundary (operands are already counted by the caller).
+Rematerialization is ignored, so the estimate is an upper bound of what
+XLA must schedule around.
+
+**Argument liveness (SC303 input).** The same scan records, for every
+top-level entry-point argument, how many eqns reference it — an argument
+referenced exactly once is provably dead after that use, and if it is
+large and never donated, ``donate_argnums`` would halve its footprint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Iterable, Mapping, Optional
+
+#: Replication-type casts, not communication: jax's check_rep (0.4.x,
+#: ``pbroadcast``) / check_vma (0.5+, ``pvary``/``pcast``) rewriters insert
+#: these to move values between replicated and device-varying types. Every
+#: device already holds the bytes; nothing crosses a link.
+ZERO_COST_FRAGMENTS = ("pbroadcast", "pvary", "pcast")
+
+
+def aval_bytes(aval) -> int:
+    """Size of one aval in bytes (0 for tokens/opaque avals)."""
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        itemsize = dtype.itemsize
+    except AttributeError:  # pragma: no cover - exotic dtype object
+        import numpy as np
+
+        itemsize = np.dtype(dtype).itemsize
+    return int(math.prod(shape)) * int(itemsize)
+
+
+def parse_mesh(spec: str) -> dict:
+    """``"data=8,model=4"`` -> ``{"data": 8, "model": 4}``."""
+    axes: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, eq, size = part.partition("=")
+        if not eq or not name.strip():
+            raise ValueError(
+                f"bad mesh spec {part!r}; expected axis=size (e.g. data=8)")
+        try:
+            n = int(size)
+        except ValueError:
+            raise ValueError(
+                f"bad mesh axis size {size!r} for axis {name!r}") from None
+        if n < 1:
+            raise ValueError(f"mesh axis {name!r} must be >= 1, got {n}")
+        axes[name.strip()] = n
+    return axes
+
+
+def _axis_names(params: Mapping) -> tuple:
+    """The mesh axes a collective eqn operates over (name params vary:
+    psum uses ``axes``, all_gather ``axis_name`` as a tuple, all_to_all
+    ``axis_name`` as a bare string)."""
+    raw = params.get("axes") or params.get("axis_name")
+    if raw is None:
+        return ()
+    if isinstance(raw, (tuple, list)):
+        return tuple(str(a) for a in raw)
+    return (str(raw),)
+
+
+def comm_bytes(prim_name: str, payload_bytes: int, axis_size: int) -> int:
+    """Per-device bytes on the wire for one launch of ``prim_name`` with a
+    per-shard payload of ``payload_bytes`` over ``axis_size`` participants
+    (the ring formulas from the module docstring)."""
+    p = max(int(axis_size), 1)
+    if any(f in prim_name for f in ZERO_COST_FRAGMENTS):
+        return 0
+    if p == 1:
+        return 0  # a one-participant collective is a copy at worst
+    if "all_gather" in prim_name or "pgather" in prim_name:
+        return (p - 1) * payload_bytes
+    if ("reduce_scatter" in prim_name or "psum_scatter" in prim_name
+            or "all_to_all" in prim_name):
+        return int(round((p - 1) / p * payload_bytes))
+    if "ppermute" in prim_name or "pshuffle" in prim_name:
+        return payload_bytes
+    # all-reduce family (psum/pmax/pmin; pmean traces to psum + divide):
+    # ring all-reduce = reduce_scatter + all_gather of 1/P shards.
+    return int(round(2 * (p - 1) / p * payload_bytes))
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCost:
+    """One collective launch site and its modeled wire cost."""
+
+    op: str
+    axes: tuple
+    axis_size: int
+    payload_bytes: int  # per-device operand bytes, as traced
+    multiplier: int  # control-flow launch count (scan length product)
+    bytes: int  # comm_bytes(op, payload, axis_size) * multiplier
+    shape: tuple
+    dtype: str
+
+    def to_json(self) -> dict:
+        return {
+            "op": self.op, "axes": list(self.axes),
+            "axis_size": self.axis_size,
+            "payload_bytes": self.payload_bytes,
+            "multiplier": self.multiplier, "bytes": self.bytes,
+            "shape": list(self.shape), "dtype": self.dtype,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ArgLiveness:
+    """Liveness of one top-level entry-point argument."""
+
+    index: int
+    bytes: int
+    shape: tuple
+    dtype: str
+    use_count: int  # eqns referencing it (0 = unused input)
+
+    @property
+    def dead_after_first_use(self) -> bool:
+        return self.use_count == 1
+
+
+@dataclasses.dataclass(frozen=True)
+class CostReport:
+    """The cost model's verdict for one entry point."""
+
+    entry: str
+    collectives: tuple  # of CollectiveCost
+    total_comm_bytes: int
+    peak_hbm_bytes: int
+    args: tuple  # of ArgLiveness
+    mesh: dict  # modeled axis sizes actually applied
+
+    def to_json(self) -> dict:
+        return {
+            "entry": self.entry,
+            "total_comm_bytes": self.total_comm_bytes,
+            "peak_hbm_bytes": self.peak_hbm_bytes,
+            "collectives": [c.to_json() for c in self.collectives],
+            "args": [dataclasses.asdict(a) for a in self.args],
+            "mesh": dict(self.mesh),
+        }
+
+
+def _sub_jaxprs(params: Mapping):
+    """(param_name, core_jaxpr) pairs for one eqn's sub-jaxprs."""
+    for key, value in params.items():
+        for item in (value if isinstance(value, (tuple, list)) else (value,)):
+            jaxpr = getattr(item, "jaxpr", item)
+            if hasattr(jaxpr, "eqns"):
+                yield key, jaxpr
+
+
+def _is_comm(prim_name: str, fragments) -> bool:
+    return any(f in prim_name for f in fragments)
+
+
+def collect_collective_costs(jaxpr, *, mesh_env: Optional[dict] = None,
+                             model_mesh: Optional[Mapping] = None,
+                             multiplier: int = 1) -> list:
+    """Walk ``jaxpr`` depth-first, pricing every collective launch.
+
+    ``mesh_env`` carries the axis sizes of the innermost enclosing
+    shard_map; ``model_mesh`` overrides them per axis (the ``--mesh``
+    contract). ``multiplier`` accumulates enclosing scan lengths.
+    """
+    from tpu_dist.analysis.jaxpr_checks import _COLLECTIVE_FRAGMENTS
+
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    mesh_env = dict(mesh_env or {})
+    model_mesh = dict(model_mesh or {})
+    out: list[CollectiveCost] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if _is_comm(name, ZERO_COST_FRAGMENTS):
+            continue  # replication-type casts: no launch, no bytes
+        if _is_comm(name, _COLLECTIVE_FRAGMENTS):
+            axes = _axis_names(eqn.params)
+            size = 1
+            for a in axes:
+                size *= int(model_mesh.get(
+                    a, mesh_env.get(a, eqn.params.get("axis_size", 1))))
+            aval = eqn.invars[0].aval if eqn.invars else None
+            payload = aval_bytes(aval) if aval is not None else 0
+            shape = tuple(getattr(aval, "shape", ()) or ())
+            dtype = str(getattr(aval, "dtype", ""))
+            per_launch = comm_bytes(name, payload, size)
+            out.append(CollectiveCost(
+                op=name, axes=axes, axis_size=size,
+                payload_bytes=payload, multiplier=multiplier,
+                bytes=per_launch * multiplier, shape=shape, dtype=dtype))
+            continue
+        inner_env = mesh_env
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            if mesh is not None and hasattr(mesh, "shape"):
+                inner_env = dict(mesh_env)
+                inner_env.update(
+                    {str(k): int(v) for k, v in dict(mesh.shape).items()})
+        inner_mult = multiplier
+        if name == "scan":
+            inner_mult = multiplier * int(eqn.params.get("length", 1))
+        for _, sub in _sub_jaxprs(eqn.params):
+            out.extend(collect_collective_costs(
+                sub, mesh_env=inner_env, model_mesh=model_mesh,
+                multiplier=inner_mult))
+    return out
+
+
+def _boundary_bytes(jaxpr) -> int:
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    consts = getattr(jaxpr, "consts", ())
+    total = sum(aval_bytes(v.aval) for v in core.invars)
+    total += sum(aval_bytes(v.aval) for v in core.constvars)
+    del consts
+    return total
+
+
+def peak_live_bytes(jaxpr) -> int:
+    """Linear-scan liveness peak over one jaxpr (recursing into
+    sub-jaxprs; see module docstring for the accounting)."""
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    eqns = core.eqns
+    last_use: dict[int, int] = {}
+    var_size: dict[int, int] = {}
+
+    def note(v, idx):
+        key = id(v)
+        var_size[key] = aval_bytes(v.aval)
+        last_use[key] = idx
+
+    for v in list(core.invars) + list(core.constvars):
+        note(v, -1)
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if hasattr(v, "aval") and not _is_literal(v):
+                note(v, i)
+    for v in core.outvars:
+        if hasattr(v, "aval") and not _is_literal(v):
+            note(v, len(eqns))
+
+    live = sum(var_size[id(v)]
+               for v in set(list(core.invars) + list(core.constvars)))
+    peak = live
+    for i, eqn in enumerate(eqns):
+        inner = 0
+        for _, sub in _sub_jaxprs(eqn.params):
+            inner = max(inner,
+                        peak_live_bytes(sub) - _boundary_bytes(sub))
+        born = 0
+        for v in eqn.outvars:
+            if hasattr(v, "aval"):
+                born += var_size.get(id(v), aval_bytes(v.aval))
+        live += born
+        peak = max(peak, live + max(0, inner))
+        # Deaths: operands whose last use is this eqn, and outvars that
+        # are never read (dropped results die immediately).
+        dead = 0
+        seen: set[int] = set()
+        for v in list(eqn.invars) + list(eqn.outvars):
+            if _is_literal(v) or not hasattr(v, "aval"):
+                continue
+            key = id(v)
+            if key in seen:
+                continue
+            seen.add(key)
+            if last_use.get(key, i) <= i:
+                dead += var_size.get(key, aval_bytes(v.aval))
+        live -= dead
+    return peak
+
+
+def _is_literal(v) -> bool:
+    return type(v).__name__ == "Literal"
+
+
+def arg_liveness(jaxpr) -> list:
+    """Per-argument use counts over the TOP-LEVEL eqn list (a use inside
+    a sub-jaxpr counts at the eqn that closes over it)."""
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    counts = {id(v): 0 for v in core.invars}
+    for eqn in core.eqns:
+        for v in set(id(x) for x in eqn.invars if hasattr(x, "aval")):
+            if v in counts:
+                counts[v] += 1
+    for v in core.outvars:
+        if hasattr(v, "aval") and id(v) in counts:
+            counts[id(v)] += 1  # returned unchanged: alive to the end
+    out = []
+    for i, v in enumerate(core.invars):
+        aval = v.aval
+        out.append(ArgLiveness(
+            index=i, bytes=aval_bytes(aval),
+            shape=tuple(getattr(aval, "shape", ()) or ()),
+            dtype=str(getattr(aval, "dtype", "")),
+            use_count=counts[id(v)]))
+    return out
+
+
+def analyze_jaxpr(closed, *, entry: str,
+                  model_mesh: Optional[Mapping] = None) -> CostReport:
+    """The full cost-model verdict for one traced entry point."""
+    colls = collect_collective_costs(closed, model_mesh=model_mesh)
+    return CostReport(
+        entry=entry,
+        collectives=tuple(colls),
+        total_comm_bytes=sum(c.bytes for c in colls),
+        peak_hbm_bytes=peak_live_bytes(closed),
+        args=tuple(arg_liveness(closed)),
+        mesh=dict(model_mesh or {}),
+    )
+
+
+#: Arguments smaller than this never trip SC303 — donating a kilobyte
+#: buys nothing and the rule is about the multi-MiB batches/activations.
+SC303_MIN_BYTES = 1 << 20
+
+
+def sc303_findings(report: CostReport, *, path: str,
+                   donated: Iterable[int] = (),
+                   min_bytes: int = SC303_MIN_BYTES) -> list:
+    """SC303: large entry-point args provably dead after one use and
+    never donated (see rules.py)."""
+    from tpu_dist.analysis.rules import Finding
+
+    donated = set(donated)
+    findings = []
+    for arg in report.args:
+        if (arg.bytes >= min_bytes and arg.dead_after_first_use
+                and arg.index not in donated):
+            findings.append(Finding(
+                "SC303", path, 1, 0,
+                f"{report.entry}: argument {arg.index} "
+                f"({arg.dtype}{list(arg.shape)}, {arg.bytes} bytes) is "
+                "dead after its single use but never donated; "
+                "jit(donate_argnums=...) would alias it away and cut "
+                "peak HBM by its size"))
+    return findings

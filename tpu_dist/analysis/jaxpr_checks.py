@@ -1,10 +1,13 @@
-"""shardcheck jaxpr-level checks — collective-order consistency under trace.
+"""shardcheck jaxpr-level checks — collective consistency under trace.
 
 The AST pass sees spelling; this pass sees the program XLA will actually
-partition. Representative entry points (the trainer step and both pipeline
-schedules) are traced on CPU with ``jax.make_jaxpr`` — tracing compiles
-nothing and needs no TPU — and the resulting jaxprs are walked for the
-deadlock-class bug the reference's TF runtime ordered away:
+partition. Representative entry points (the trainer step, both pipeline
+schedules, the TP/SP/MoE parallel families, the resilience and observe
+demo steps) are traced on CPU with ``jax.make_jaxpr`` — tracing compiles
+nothing and needs no TPU — and the resulting jaxprs are walked
+interprocedurally (descending into ``pjit``/``scan``/``while``/``cond``/
+``remat``/``custom_vjp`` sub-jaxprs) for the deadlock classes the
+reference's TF runtime ordered away:
 
 **SC201 — collective-order divergence.** In an SPMD program every device
 runs the same instruction stream, so collectives pair up by construction —
@@ -17,9 +20,30 @@ each other and the program deadlocks. This is why
 forward/backward/idle switch; the check pins that invariant for every
 entry point and every user program that registers one.
 
+**SC202 — data-dependent collective trip count.** A collective inside a
+``lax.while_loop`` body launches once per iteration, and a while's trip
+count is data-dependent by construction — ranks whose predicates diverge
+launch different counts and the rendezvous mismatches. (A static-length
+``lax.scan`` is fine: every rank runs exactly L iterations.)
+
+**SC203 — collective payload mismatch.** Launches that pair up by order
+but not by payload: cond/switch branches issuing the same collective
+sequence over different payload shapes/dtypes (rank A psums f32[2,4]
+against rank B's f32[4,4] — hang or garbage), and ``ppermute``
+permutations invalid for the axis in effect (out-of-range index,
+duplicate source, duplicate destination — all trace fine today).
+
+Note on ``pbroadcast``/``pvary``: jax's check_rep (0.4.x) / check_vma
+(0.5+) rewriter inserts these replication-type casts into traced bodies,
+*including asymmetrically into cond branches whose values differ in
+replication only*. They move no bytes and launch nothing, so they are NOT
+collectives for any rule here — treating them as real traffic made SC201
+false-positive on ring attention's causal skip branch.
+
 User programs opt in by defining a module-level ``shardcheck_entry()``
-returning ``(fn, example_args)``; the CLI traces it and applies the same
-checks (see cli.py).
+returning ``(fn, example_args)`` — or ``(fn, example_args,
+donate_argnums)`` to tell SC303 which arguments the production caller
+donates; the CLI traces it and applies the same checks (see cli.py).
 """
 
 from __future__ import annotations
@@ -33,14 +57,25 @@ logger = logging.getLogger("tpu_dist.analysis")
 
 #: Primitive-name fragments that identify cross-device collectives in a
 #: jaxpr. Substring match keeps this robust across jax renames
-#: (psum/psum2/psum_invariant all count).
+#: (psum/psum2/psum_invariant all count). pbroadcast/pvary are absent by
+#: design — see the module docstring.
 _COLLECTIVE_FRAGMENTS = ("psum", "pmax", "pmin", "ppermute", "all_gather",
-                         "all_to_all", "pbroadcast", "reduce_scatter",
-                         "pgather", "pshuffle")
+                         "all_to_all", "reduce_scatter", "pgather",
+                         "pshuffle")
 
 
 def _is_collective(prim_name: str) -> bool:
     return any(f in prim_name for f in _COLLECTIVE_FRAGMENTS)
+
+
+def _cause(e: BaseException, limit: int = 160) -> str:
+    """``ExceptionType: first line of the message`` — jax trace errors run
+    to pages, and a multi-line info finding buries the tier-1 log line
+    that explains WHY an entry point degraded."""
+    first = (str(e).splitlines() or [""])[0].strip()
+    if len(first) > limit:
+        first = first[:limit - 1] + "…"
+    return f"{type(e).__name__}: {first}" if first else type(e).__name__
 
 
 def _inner_jaxprs(params: dict):
@@ -53,55 +88,188 @@ def _inner_jaxprs(params: dict):
                 yield jaxpr
 
 
-def collective_sequence(jaxpr) -> list[str]:
-    """Depth-first sequence of collective primitive names issued by a
-    jaxpr, descending into every sub-jaxpr (program launch order for
+def _collective_uses(jaxpr) -> list:
+    """Depth-first ``(name, axes, shape, dtype)`` tuples for every
+    collective launch a jaxpr issues (program launch order for
     straight-line code; branch bodies contribute in branch order)."""
+    from tpu_dist.analysis.costmodel import _axis_names
+
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
-    out: list[str] = []
+    out = []
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
         if _is_collective(name):
-            axes = eqn.params.get("axes") or eqn.params.get("axis_name")
-            out.append(f"{name}[{axes}]" if axes else name)
+            aval = eqn.invars[0].aval if eqn.invars else None
+            out.append((name, _axis_names(eqn.params),
+                        tuple(getattr(aval, "shape", ()) or ()),
+                        str(getattr(aval, "dtype", ""))))
         for sub in _inner_jaxprs(eqn.params):
-            out.extend(collective_sequence(sub))
+            out.extend(_collective_uses(sub))
+    return out
+
+
+def collective_sequence(jaxpr) -> list[str]:
+    """Depth-first sequence of collective primitive names issued by a
+    jaxpr, descending into every sub-jaxpr."""
+    out = []
+    for name, axes, _, _ in _collective_uses(jaxpr):
+        out.append(f"{name}[{axes}]" if axes else name)
     return out
 
 
 def check_branch_collectives(jaxpr, *, label: str,
                              path: str = "<trace>") -> list[Finding]:
-    """SC201: every ``cond``/``switch`` whose branches issue differing
-    collective sequences, anywhere in the jaxpr."""
+    """SC201/SC203a over every ``cond``/``switch`` anywhere in the jaxpr:
+    branches must issue the same collective sequence (SC201), over the
+    same payload shapes/dtypes (SC203)."""
     jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
     findings: list[Finding] = []
     for eqn in jaxpr.eqns:
         if eqn.primitive.name == "cond":
-            branches = eqn.params.get("branches", ())
-            seqs = [collective_sequence(b) for b in branches]
-            if len({tuple(s) for s in seqs}) > 1:
+            uses = [_collective_uses(b)
+                    for b in eqn.params.get("branches", ())]
+            orders = [tuple((n, a) for n, a, _, _ in u) for u in uses]
+            if len(set(orders)) > 1:
                 desc = ", ".join(
-                    f"branch {i}: {s or ['<none>']}"
-                    for i, s in enumerate(seqs))
+                    f"branch {i}: "
+                    f"{[f'{n}[{a}]' for n, a in o] or ['<none>']}"
+                    for i, o in enumerate(orders))
                 findings.append(Finding(
                     "SC201", path, 1, 0,
                     f"{label}: cond/switch branches issue different "
                     f"collective sequences ({desc}); devices taking "
                     "different branches will deadlock — hoist the "
                     "collective out of the branch"))
+            elif len({tuple(u) for u in uses}) > 1:
+                desc = ", ".join(
+                    f"branch {i}: "
+                    f"{[f'{n}[{a}] {d}{list(s)}' for n, a, s, d in u]}"
+                    for i, u in enumerate(uses))
+                findings.append(Finding(
+                    "SC203", path, 1, 0,
+                    f"{label}: cond/switch branches issue the same "
+                    f"collective sequence over DIFFERENT payloads "
+                    f"({desc}); ranks taking different branches "
+                    "rendezvous with mismatched shapes/dtypes — hang or "
+                    "garbage on real hardware"))
         for sub in _inner_jaxprs(eqn.params):
             findings.extend(check_branch_collectives(
                 sub, label=label, path=path))
     return findings
 
 
+def check_while_collectives(jaxpr, *, label: str,
+                            path: str = "<trace>") -> list[Finding]:
+    """SC202: any collective reachable from a ``while`` eqn's body or
+    predicate, anywhere in the jaxpr."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    findings: list[Finding] = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "while":
+            for part in ("cond_jaxpr", "body_jaxpr"):
+                sub = eqn.params.get(part)
+                if sub is None:
+                    continue
+                uses = _collective_uses(sub)
+                if uses:
+                    ops = sorted({f"{n}[{a}]" for n, a, _, _ in uses})
+                    where = ("predicate" if part == "cond_jaxpr"
+                             else "body")
+                    findings.append(Finding(
+                        "SC202", path, 1, 0,
+                        f"{label}: {', '.join(ops)} inside a while-loop "
+                        f"{where}; the trip count is data-dependent, so "
+                        "ranks whose predicates diverge launch different "
+                        "collective counts and deadlock — use a "
+                        "static-length scan, or hoist the collective "
+                        "out of the loop"))
+        else:
+            for sub in _inner_jaxprs(eqn.params):
+                findings.extend(check_while_collectives(
+                    sub, label=label, path=path))
+    return findings
+
+
+def check_permutes(jaxpr, *, label: str, path: str = "<trace>",
+                   mesh_env: Optional[dict] = None,
+                   model_mesh: Optional[dict] = None) -> list[Finding]:
+    """SC203b: every ``ppermute`` permutation must be valid for the mesh
+    axis in effect — indices in ``[0, P)``, no duplicate source, no
+    duplicate destination. jax traces all three violations without
+    complaint; on the machine a duplicate destination is two sends
+    racing one receive and an out-of-range index is a hang."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    mesh_env = dict(mesh_env or {})
+    model_mesh = dict(model_mesh or {})
+    findings: list[Finding] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if "ppermute" in name:
+            from tpu_dist.analysis.costmodel import _axis_names
+
+            axes = _axis_names(eqn.params)
+            size = 1
+            for a in axes:
+                size *= int(model_mesh.get(a, mesh_env.get(a, 0)) or 0)
+            perm = tuple(eqn.params.get("perm", ()))
+            problems = []
+            if size > 0:
+                bad = [p for p in perm
+                       if not (0 <= p[0] < size and 0 <= p[1] < size)]
+                if bad:
+                    problems.append(
+                        f"indices {sorted(set(bad))} outside the axis "
+                        f"size {size}")
+            srcs = [s for s, _ in perm]
+            dsts = [d for _, d in perm]
+            if len(set(srcs)) != len(srcs):
+                problems.append("duplicate sources")
+            if len(set(dsts)) != len(dsts):
+                problems.append("duplicate destinations (two sends "
+                                "racing one receive)")
+            if problems:
+                findings.append(Finding(
+                    "SC203", path, 1, 0,
+                    f"{label}: ppermute over axis {axes} has an invalid "
+                    f"permutation — {'; '.join(problems)} — perm={perm}"))
+        inner_env = mesh_env
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            if mesh is not None and hasattr(mesh, "shape"):
+                inner_env = dict(mesh_env)
+                inner_env.update(
+                    {str(k): int(v) for k, v in dict(mesh.shape).items()})
+        for sub in _inner_jaxprs(eqn.params):
+            findings.extend(check_permutes(
+                sub, label=label, path=path, mesh_env=inner_env,
+                model_mesh=model_mesh))
+    return findings
+
+
+def check_jaxpr(closed, *, label: str, path: str = "<trace>",
+                donated: Iterable[int] = ()) -> list[Finding]:
+    """Every jaxpr-level rule over one traced entry point: SC201/SC203a
+    (branch divergence), SC202 (while collectives), SC203b (permutation
+    validity), SC303 (undonated dead arguments)."""
+    from tpu_dist.analysis import costmodel
+
+    findings = check_branch_collectives(closed, label=label, path=path)
+    findings.extend(check_while_collectives(closed, label=label, path=path))
+    findings.extend(check_permutes(closed, label=label, path=path))
+    report = costmodel.analyze_jaxpr(closed, entry=label)
+    findings.extend(costmodel.sc303_findings(
+        report, path=path, donated=donated))
+    return findings
+
+
 def check_callable(fn: Callable, args: tuple, *, label: str,
-                   path: str = "<trace>") -> list[Finding]:
+                   path: str = "<trace>",
+                   donated: Iterable[int] = ()) -> list[Finding]:
     """Trace ``fn(*args)`` and run every jaxpr-level rule on the result."""
     import jax
 
     closed = jax.make_jaxpr(fn)(*args)
-    return check_branch_collectives(closed, label=label, path=path)
+    return check_jaxpr(closed, label=label, path=path, donated=donated)
 
 
 # -- built-in entry points ----------------------------------------------------
@@ -260,34 +428,133 @@ def _trace_observe_demo_step():
         collectives.install_observe_hook(prev)
 
 
+def _trace_megatron_block():
+    """The tensor-parallel MLP block's collective pattern (parallel/
+    tensor.py): column-parallel up-projection, row-parallel down-
+    projection, one partial-sum all-reduce back to the residual stream.
+    tensor.py expresses this as GSPMD sharding ANNOTATIONS (XLA derives
+    the psum at compile time, invisible to make_jaxpr), so the entry
+    traces the equivalent explicit shard_map program — the communication
+    contract the annotations imply, priced and rule-checked."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dist.parallel import mesh as mesh_lib
+    from tpu_dist.parallel.axes import MODEL_AXIS
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise RuntimeError("needs >= 4 devices for a model mesh")
+    mesh = mesh_lib.make_mesh({MODEL_AXIS: 4}, devices=devices[:4])
+
+    def block(x, w1, w2):
+        h = jnp.maximum(x @ w1, 0.0)  # column-parallel: w1 [d, f/P]
+        y = h @ w2                    # row-parallel:    w2 [f/P, d]
+        return jax.lax.psum(y, MODEL_AXIS)
+
+    mapped = _shard_mapped(
+        block, mesh,
+        (P(), P(None, MODEL_AXIS), P(MODEL_AXIS, None)), P())
+    return jax.make_jaxpr(mapped)(
+        jnp.zeros((16, 8)), jnp.ones((8, 32)), jnp.ones((32, 8)))
+
+
+def _trace_ring_attention():
+    """Causal ring attention over a 4-way seq mesh (parallel/sequence.py):
+    the K/V ppermute ring inside a static-length scan, plus the causal
+    skip cond — the branch that must stay collective-free for SC201."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.parallel import mesh as mesh_lib
+    from tpu_dist.parallel.axes import SEQ_AXIS
+    from tpu_dist.parallel.sequence import ring_attention
+
+    devices = jax.devices()
+    if len(devices) < 4:
+        raise RuntimeError("needs >= 4 devices for a seq mesh")
+    mesh = mesh_lib.make_mesh({SEQ_AXIS: 4}, devices=devices[:4])
+    q = jnp.zeros((2, 2, 16, 4))
+
+    def attend(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh, causal=True)
+
+    return jax.make_jaxpr(attend)(q, q, q)
+
+
+def _trace_moe_layer():
+    """MixtureOfExperts' sharded apply under a data x expert strategy
+    scope (parallel/expert.py): the all_to_all dispatch/return pair plus
+    the aux-loss pmeans over both axes."""
+    import jax
+    import jax.numpy as jnp
+
+    import tpu_dist as td
+    from tpu_dist.parallel.axes import DATA_AXIS, EXPERT_AXIS
+    from tpu_dist.parallel.expert import MixtureOfExperts
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        raise RuntimeError("needs >= 8 devices for a data x expert mesh")
+    strategy = td.MirroredStrategy(
+        axis_shapes={DATA_AXIS: 2, EXPERT_AXIS: 4})
+    with strategy.scope():
+        layer = MixtureOfExperts(num_experts=4, ff_dim=16, top_k=2)
+        params, state, _ = layer.init(jax.random.PRNGKey(0), (8, 8, 8))
+        x = jnp.zeros((8, 8, 8))
+        return jax.make_jaxpr(
+            lambda p, xx: layer.apply(p, state, xx)[0])(params, x)
+
+
 ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
     "training.trainer.train_step": _trace_train_step,
     "resilience.entrypoints.demo_train_step": _trace_resilience_demo_step,
     "observe.demo_train_step": _trace_observe_demo_step,
+    "parallel.tensor.megatron_block": _trace_megatron_block,
+    "parallel.sequence.ring_attention": _trace_ring_attention,
+    "parallel.expert.moe_layer": _trace_moe_layer,
 }
 
+#: Argument positions each entry point's production caller donates
+#: (consumed by SC303). None of the built-in steps donate today; the map
+#: exists so registering a donating entry point is one line.
+ENTRY_DONATED: dict[str, tuple] = {}
 
-def run_entry_points(
-        names: Optional[Iterable[str]] = None) -> list[Finding]:
-    """Trace every built-in entry point and collect SC201 findings. An
-    entry point that cannot trace in this environment (too few devices, a
-    moved jax internal) degrades to an SC900 info finding, never a crash —
-    the lint pass's results still stand."""
+
+def trace_entry_points(
+        names: Optional[Iterable[str]] = None) -> tuple[dict, list]:
+    """Trace every built-in entry point. Returns ``(traced, findings)``
+    where ``traced`` maps name -> ClosedJaxpr and ``findings`` carries an
+    SC900 info finding (exception class + one-line cause) for each entry
+    that cannot trace in this environment — degrade, never crash."""
+    traced: dict = {}
     findings: list[Finding] = []
     for name, tracer in ENTRY_POINTS.items():
         if names is not None and name not in names:
             continue
         try:
-            closed = tracer()
+            traced[name] = tracer()
         except Exception as e:  # noqa: BLE001 - degrade, never crash
             logger.debug("entry point %s untraceable", name, exc_info=True)
             findings.append(Finding(
                 "SC900", f"<entry:{name}>", 1, 0,
                 f"entry point {name} could not be traced here "
-                f"({type(e).__name__}: {e}); SC201 skipped for it"))
-            continue
-        findings.extend(check_branch_collectives(
-            closed, label=name, path=f"<entry:{name}>"))
+                f"({_cause(e)}); jaxpr rules skipped for it"))
+    return traced, findings
+
+
+def run_entry_points(
+        names: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Trace every built-in entry point and collect jaxpr-rule findings.
+    An entry point that cannot trace in this environment (too few
+    devices, a moved jax internal) degrades to an SC900 info finding,
+    never a crash — the lint pass's results still stand."""
+    traced, findings = trace_entry_points(names)
+    for name, closed in traced.items():
+        findings.extend(check_jaxpr(
+            closed, label=name, path=f"<entry:{name}>",
+            donated=ENTRY_DONATED.get(name, ())))
     return findings

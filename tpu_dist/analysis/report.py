@@ -62,6 +62,89 @@ def render_text(findings: Iterable[Finding], *, paths=(),
         print("shardcheck: no findings", file=stream)
 
 
+#: GitHub workflow-command levels by severity. There is no ::info; the
+#: annotation vocabulary is error/warning/notice.
+_GITHUB_LEVEL = {
+    Severity.ERROR: "error",
+    Severity.WARNING: "warning",
+    Severity.INFO: "notice",
+}
+
+
+def _github_escape(text: str) -> str:
+    """Workflow-command data escaping: %, CR and LF are the only
+    characters the parser treats specially in the message position."""
+    return (text.replace("%", "%25")
+            .replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+def render_github(findings: Iterable[Finding], *, stream=None) -> None:
+    """Findings as GitHub workflow annotations
+    (``::error file=...,line=...,col=...::[SCnnn] message``)."""
+    stream = stream or sys.stdout
+    for f in sort_findings(findings):
+        level = _GITHUB_LEVEL[f.severity]
+        message = _github_escape(f"[{f.rule_id}] {f.message}")
+        print(f"::{level} file={f.path},line={f.line},col={f.col}::"
+              f"{message}", file=stream)
+
+
+def _human_bytes(n: int) -> str:
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if value < 1024 or unit == "GiB":
+            return (f"{int(value)} {unit}" if unit == "B"
+                    else f"{value:.1f} {unit}")
+        value /= 1024
+    return f"{n} B"  # pragma: no cover - unreachable
+
+
+def render_cost_text(reports, findings: Iterable[Finding] = (), *,
+                     mesh=None, stream=None) -> None:
+    """Human-readable cost report: one block per entry point (totals plus
+    every collective launch site), then any baseline findings."""
+    stream = stream or sys.stdout
+    if mesh:
+        print("modeled mesh: "
+              + ",".join(f"{k}={v}" for k, v in sorted(mesh.items())),
+              file=stream)
+    for name in sorted(reports):
+        r = reports[name]
+        print(f"{name}: comm {r.total_comm_bytes} B "
+              f"({_human_bytes(r.total_comm_bytes)}), peak HBM "
+              f"{r.peak_hbm_bytes} B ({_human_bytes(r.peak_hbm_bytes)}), "
+              f"{len(r.collectives)} collective launch site(s)",
+              file=stream)
+        for c in r.collectives:
+            axes = ",".join(c.axes) or "?"
+            mult = f" x{c.multiplier}" if c.multiplier != 1 else ""
+            print(f"  {c.op}[{axes}|{c.axis_size}] "
+                  f"{c.dtype}{list(c.shape)} = {c.payload_bytes} B"
+                  f"{mult} -> {c.bytes} B", file=stream)
+    findings = sort_findings(findings)
+    for f in findings:
+        print(f.render(), file=stream)
+    if not findings:
+        print("shardcheck cost: no findings", file=stream)
+
+
+def to_cost_json(reports, findings: Iterable[Finding] = (), *,
+                 mesh=None, baseline_path=None,
+                 fail_on: str = "error") -> dict:
+    findings = sort_findings(findings)
+    return {
+        "tool": "shardcheck-cost",
+        "mesh": dict(mesh or {}),
+        "baseline": baseline_path,
+        "entries": {name: reports[name].to_json()
+                    for name in sorted(reports)},
+        "counts": counts_by_severity(findings),
+        "findings": [f.to_json() for f in findings],
+        "exit_code": exit_code(findings, fail_on=fail_on),
+    }
+
+
 def render_rules(stream=None) -> None:
     """The advertised catalogue, for ``--list-rules``."""
     stream = stream or sys.stdout

@@ -93,6 +93,44 @@ RULES = {r.id: r for r in (
         "deadlocks — the bug class TF's runtime ordered away and XLA "
         "will not catch for you."),
     Rule(
+        "SC202", "data-dependent-collective-trip-count", Severity.ERROR,
+        "A collective inside a lax.while_loop body (or its predicate). "
+        "A while trip count is data-dependent by construction — unlike "
+        "scan's static length — so ranks whose predicates diverge run "
+        "different numbers of collective launches and the mismatched "
+        "rendezvous deadlocks. Prove the trip count rank-uniform and "
+        "rewrite as a bounded scan, or hoist the collective out."),
+    Rule(
+        "SC203", "collective-payload-mismatch", Severity.ERROR,
+        "Paired collective launches whose payloads cannot line up across "
+        "ranks: cond/switch branches issuing the same collective "
+        "sequence but with different payload shapes/dtypes, or a "
+        "ppermute whose permutation is invalid for the mesh axis in "
+        "effect (index out of range, duplicate source, duplicate "
+        "destination). Both trace fine and hang or corrupt at the "
+        "rendezvous on real hardware."),
+    Rule(
+        "SC301", "comm-budget-regression", Severity.ERROR,
+        "An entry point's total modeled communication volume exceeds "
+        "the committed baseline (ANALYSIS_BASELINE.json) by more than "
+        "the tolerance. Comm regressions only show up as step-time "
+        "cliffs at pod scale; the static diff catches them in CI. "
+        "Intended growth: re-run with --update-baseline and commit."),
+    Rule(
+        "SC302", "peak-hbm-over-budget", Severity.WARNING,
+        "An entry point's estimated per-rank peak live-buffer bytes "
+        "exceed the baseline's HBM budget. The linear-scan liveness "
+        "estimate is an upper bound (rematerialization ignored), so "
+        "this is a warning, not an error — but a jump usually means a "
+        "batch/width change that will OOM first on the real machine."),
+    Rule(
+        "SC303", "undonated-dead-argument", Severity.WARNING,
+        "A large entry-point argument whose jaxpr liveness proves it "
+        "dead after its single use, yet never donated. XLA must keep "
+        "the input buffer alive alongside its replacement; "
+        "donate_argnums would alias them and halve that footprint. "
+        "The jaxpr-proof deepening of SC104's AST guess."),
+    Rule(
         "SC900", "entry-point-untraceable", Severity.INFO,
         "A registered jaxpr-check entry point could not be traced in "
         "this environment; its collective-order check was skipped."),
