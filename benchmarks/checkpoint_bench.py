@@ -1,0 +1,208 @@
+"""Checkpoint stall benchmark: sync vs async save on the fit critical path.
+
+What it measures
+----------------
+``checkpoint.stall_s`` — the wall-clock the TRAINING thread loses to one
+checkpoint — for the two pipelines in ``tpu_dist.training.checkpoint``:
+
+* **sync** (``ModelCheckpoint(async_save=False)``): the epoch boundary pays
+  device->host transfer + np.savez + fsync + atomic publish, serially;
+* **async** (``async_save=True``, the default): the boundary pays only the
+  on-device snapshot dispatch + host transfer of the copies; serialization,
+  fsync and publish run on a background writer thread overlapping the next
+  epoch's steps.
+
+Both paths record the same ``checkpoint.stall_s`` distribution in
+``tpu_dist.observe.metrics``, so the comparison is one series read twice
+(registry reset between runs). The model is sized so serialization/fsync
+dominates the boundary (the thing the async pipeline moves off the critical
+path) and each epoch is long enough that the background write finishes
+before the next save drains it — the steady state the pipeline targets.
+
+Gates (non-vacuous by construction; exit 1 on failure)
+------------------------------------------------------
+* at least one sync save and one async save were actually recorded;
+* mean async stall <= ``--stall-ratio`` (default 0.20) x mean sync stall;
+* resume parity: a sync save and an async save of the SAME live model
+  state restore bit-identically, leaf by leaf.
+
+Writes ``BENCH_CHECKPOINT.json`` (see ``--out``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tpu_dist.data import Dataset
+from tpu_dist.models import Dense, Sequential
+from tpu_dist.observe import metrics
+from tpu_dist.ops import Adam, SparseCategoricalCrossentropy
+from tpu_dist.training import ModelCheckpoint, checkpoint
+
+FEATURES = 256
+CLASSES = 10
+
+
+def _model(seed_lr: float = 1e-3) -> Sequential:
+    # ~0.5M parameters -> ~1.5M floats with Adam moments: a checkpoint big
+    # enough (several MB of npz) that serialization+fsync dominates the save,
+    # small enough for CI.
+    m = Sequential(
+        [Dense(512, activation="relu"), Dense(512, activation="relu"),
+         Dense(256, activation="relu"), Dense(CLASSES)],
+        input_shape=(FEATURES,))
+    m.compile(loss=SparseCategoricalCrossentropy(from_logits=True),
+              optimizer=Adam(learning_rate=seed_lr), metrics=[])
+    return m
+
+
+def _dataset(*, steps: int, batch: int) -> Dataset:
+    rng = np.random.default_rng(7)
+    n = steps * batch
+    y = rng.integers(CLASSES, size=n).astype(np.int64)
+    x = rng.normal(0, 1, (n, FEATURES)).astype(np.float32)
+    return Dataset.from_tensor_slices((x, y)).batch(batch)
+
+
+def _fit_run(*, async_save: bool, directory: str, epochs: int,
+             steps: int, batch: int, seed: int) -> dict:
+    """One measured fit; returns the registry's checkpoint.* view plus
+    steps/s (epoch 0 dropped — it carries compile)."""
+    metrics.get_registry().reset()
+    metrics.enable()
+    try:
+        m = _model()
+        cb = ModelCheckpoint(directory, async_save=async_save)
+        h = m.fit(_dataset(steps=steps, batch=batch), epochs=epochs,
+                  steps_per_epoch=steps, verbose=0, seed=seed,
+                  callbacks=[cb])
+        epoch_times = h.history["epoch_time"][1:]
+        snap = metrics.get_registry().snapshot()
+    finally:
+        metrics.disable()
+    dist = snap["distributions"].get("checkpoint.stall_s") or {}
+    counters = snap["counters"]
+    saves = counters.get(
+        "checkpoint.async_saves" if async_save else "checkpoint.sync_saves",
+        0)
+    return {
+        "mode": "async" if async_save else "sync",
+        "saves": saves,
+        "stall_s": dist,
+        "mean_stall_s": (dist.get("sum", 0.0) / dist["count"]
+                         if dist.get("count") else None),
+        "write_s": snap["distributions"].get("checkpoint.write_s"),
+        "snapshot_s": snap["distributions"].get("checkpoint.snapshot_s"),
+        "commit_s": snap["distributions"].get("checkpoint.commit_s"),
+        "write_errors": counters.get("checkpoint.write_errors", 0),
+        "steps_per_s": (round(steps * len(epoch_times)
+                              / sum(epoch_times), 2)
+                        if epoch_times and sum(epoch_times) > 0 else None),
+        "final_loss": float(h.history["loss"][-1]),
+    }
+
+
+def _resume_parity(workdir: pathlib.Path, *, steps: int,
+                   batch: int) -> dict:
+    """Save the SAME live model state through both pipelines; restore both;
+    every leaf must be bit-identical (np.array_equal on raw arrays)."""
+    m = _model()
+    m.fit(_dataset(steps=steps, batch=batch), epochs=1,
+          steps_per_epoch=steps, verbose=0, seed=11)
+    sync_dir, async_dir = workdir / "parity-sync", workdir / "parity-async"
+    checkpoint.save(str(sync_dir), m, step=0)
+    with checkpoint.AsyncCheckpointer(str(async_dir)) as ckpt:
+        ckpt.save_async(m, step=0)
+    a, _ = checkpoint.restore(str(sync_dir), checkpoint._saveable(m))
+    b, _ = checkpoint.restore(str(async_dir), checkpoint._saveable(m))
+    flat_a = checkpoint._flatten(a)
+    flat_b = checkpoint._flatten(b)
+    mismatched = sorted(
+        k for k in flat_a
+        if not np.array_equal(np.asarray(flat_a[k]), np.asarray(flat_b[k])))
+    return {
+        "leaves": len(flat_a),
+        "bit_identical": (not mismatched
+                          and set(flat_a) == set(flat_b)
+                          and len(flat_a) > 0),
+        "mismatched_leaves": mismatched[:8],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--epochs", type=int, default=6,
+                   help="measured epochs per run (one save each; default 6)")
+    p.add_argument("--steps", type=int, default=60,
+                   help="steps per epoch (default 60 — sized so an epoch "
+                        "outlasts one background write)")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--stall-ratio", type=float, default=0.20,
+                   help="gate: async mean stall <= ratio x sync mean stall")
+    p.add_argument("--out", default=str(pathlib.Path(__file__).parent.parent
+                                        / "BENCH_CHECKPOINT.json"))
+    args = p.parse_args(argv)
+
+    workdir = pathlib.Path(tempfile.mkdtemp(prefix="tpu-dist-ckpt-bench-"))
+    print(f"workdir: {workdir}", file=sys.stderr)
+
+    # Warmup absorbs jit compile of the train step AND of the snapshot-copy
+    # program, so neither run's first save pays it.
+    print("warmup (compile)...", file=sys.stderr)
+    _fit_run(async_save=True, directory=str(workdir / "warmup"),
+             epochs=2, steps=args.steps, batch=args.batch, seed=5)
+
+    print("measuring sync pipeline...", file=sys.stderr)
+    sync = _fit_run(async_save=False, directory=str(workdir / "sync"),
+                    epochs=args.epochs, steps=args.steps, batch=args.batch,
+                    seed=5)
+    print("measuring async pipeline...", file=sys.stderr)
+    async_ = _fit_run(async_save=True, directory=str(workdir / "async"),
+                      epochs=args.epochs, steps=args.steps, batch=args.batch,
+                      seed=5)
+    print("checking sync/async resume bit-parity...", file=sys.stderr)
+    parity = _resume_parity(workdir, steps=8, batch=args.batch)
+
+    ratio = (async_["mean_stall_s"] / sync["mean_stall_s"]
+             if sync["mean_stall_s"] and async_["mean_stall_s"] is not None
+             else None)
+    gates = {
+        "sync_saves_recorded": sync["saves"] >= 1,
+        "async_saves_recorded": async_["saves"] >= 1,
+        "async_stall_within_ratio": (ratio is not None
+                                     and ratio <= args.stall_ratio),
+        "resume_bit_identical": parity["bit_identical"],
+    }
+    report = {
+        "bench": "checkpoint",
+        "config": {"epochs": args.epochs, "steps_per_epoch": args.steps,
+                   "batch": args.batch, "stall_ratio_gate": args.stall_ratio,
+                   "devices": int(os.environ.get(
+                       "TPU_DIST_BENCH_DEVICES", 1))},
+        "sync": sync,
+        "async": async_,
+        "stall_ratio_async_over_sync": (round(ratio, 4)
+                                        if ratio is not None else None),
+        "resume_parity": parity,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    out = pathlib.Path(args.out)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report, indent=2))
+    print(f"wrote {out}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # Repo gate: shardcheck static analysis, the resilience smoke chaos run,
-# the observe telemetry smoke/bench, then the tier-1 test suite.
+# the observe telemetry smoke/bench, the checkpoint stall bench, then the
+# tier-1 test suite.
 #
 # Usage: scripts/check.sh
 #
@@ -30,14 +31,17 @@ JAX_PLATFORMS=cpu python -m tpu_dist.analysis cost \
        "(intended? re-run with --update-baseline and commit)" >&2; exit 1; }
 
 echo "== resilience-smoke: supervised kill/restart/resume chaos run =="
-# The acceptance demo from README.md "Fault tolerance & chaos testing":
-# kill the demo worker at global step 5, supervisor restarts it, it resumes
-# from the last complete checkpoint, and the report must show loss parity
-# with the uninterrupted baseline (exit 0 only when the fault actually
-# fired AND recovery converged to the same place).
+# The acceptance demo from README.md "Fault tolerance & chaos testing",
+# extended with the zero-stall pipeline's worst case: kill the demo worker
+# at global step 5 (attempt 0), then — on the restarted attempt — kill it
+# again from INSIDE the checkpoint write seam while the epoch-2 async save
+# is staged but unpublished. The report must show both faults fired, the
+# final attempt resumed from the last PUBLISHED step (never the torn
+# stage), and loss parity with the uninterrupted baseline.
 smoke_dir=$(mktemp -d /tmp/tpu-dist-smoke.XXXXXX)
-timeout -k 10 300 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
-  --plan kill-worker@step5 --workdir "$smoke_dir" >/dev/null \
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
+  --plan kill-worker@step5,kill-during-save@epoch2:attempt1 \
+  --workdir "$smoke_dir" >/dev/null \
   || { echo "check.sh: resilience smoke chaos run failed (see $smoke_dir)" >&2
        exit 1; }
 rm -rf "$smoke_dir"
@@ -58,6 +62,16 @@ timeout -k 10 60 env JAX_PLATFORMS=cpu python -m tpu_dist.observe \
   >/dev/null \
   || { echo "check.sh: instrumented series failed validation" >&2; exit 1; }
 rm -rf "$obs_dir"
+
+echo "== checkpoint-bench: sync vs async save stall =="
+# Measures checkpoint.stall_s for both pipelines on identical seeded runs;
+# writes BENCH_CHECKPOINT.json. Gates: at least one save recorded per mode
+# (non-vacuity), mean async stall <= 20% of mean sync stall, and sync/async
+# saves of the same live state restore bit-identically.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/checkpoint_bench.py \
+  >/dev/null \
+  || { echo "check.sh: checkpoint bench gates failed" \
+       "(see BENCH_CHECKPOINT.json)" >&2; exit 1; }
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -189,3 +189,199 @@ class TestShardedCheckpoint:
                     if k in model.variables}
         with pytest.raises(FileNotFoundError, match="shared FS"):
             checkpoint.restore(tmp_path, template, step=1)
+
+
+class TestAsyncCheckpointer:
+    """Zero-stall pipeline: snapshot now, write in background, commit at the
+    next bounded wait point (next save / wait / close)."""
+
+    def _template(self, model):
+        return {k: model.variables[k] for k in ("params", "state", "opt")
+                if k in model.variables}
+
+    def _flat_host(self, tree):
+        return {k: np.asarray(v)
+                for k, v in checkpoint._flatten(tree).items()}
+
+    def test_async_roundtrip_matches_sync_bitwise(self, tmp_path,
+                                                  eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=4, verbose=0)
+        sync_dir, async_dir = tmp_path / "sync", tmp_path / "async"
+        checkpoint.save(sync_dir, model, step=0)
+        with checkpoint.AsyncCheckpointer(async_dir) as ckpt:
+            ckpt.save_async(model, step=0)
+        a, _ = checkpoint.restore(sync_dir, self._template(model))
+        b, _ = checkpoint.restore(async_dir, self._template(model))
+        fa, fb = self._flat_host(a), self._flat_host(b)
+        assert set(fa) == set(fb) and fa
+        for k in fa:
+            np.testing.assert_array_equal(fa[k], fb[k])
+
+    def test_snapshot_consistent_under_donating_steps(self, tmp_path,
+                                                      eight_devices):
+        """The snapshot must capture state AT save time: the trainer's
+        compiled steps donate their variable arguments, so training onward
+        while the write is in flight invalidates the live arrays the
+        snapshot was taken from."""
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        ds = _ds()
+        model.fit(ds, epochs=1, steps_per_epoch=4, verbose=0, seed=9)
+        ref = self._flat_host(checkpoint._saveable(model))
+
+        ckpt = checkpoint.AsyncCheckpointer(tmp_path)
+        ckpt.save_async(model, step=0)
+        # Donating steps run while the write is still in flight.
+        model.fit(ds, epochs=2, steps_per_epoch=4, verbose=0, seed=9,
+                  initial_epoch=1)
+        ckpt.close()
+
+        restored, step = checkpoint.restore(tmp_path, self._template(model))
+        assert step == 0
+        got = self._flat_host(restored)
+        for k in ref:
+            np.testing.assert_array_equal(ref[k], got[k])
+        # And training really moved on past the snapshot.
+        now = self._flat_host(checkpoint._saveable(model))
+        assert any(not np.array_equal(ref[k], now[k]) for k in ref)
+
+    def test_transient_fault_surfaces_at_wait_not_save(self, tmp_path,
+                                                       eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0)
+
+        def boom(stage, step):
+            raise OSError(f"injected write failure at step {step}")
+
+        prev = checkpoint.install_write_fault_hook(boom)
+        try:
+            ckpt = checkpoint.AsyncCheckpointer(tmp_path)
+            ckpt.save_async(model, step=0)  # must NOT raise here
+            with pytest.raises(OSError, match="injected") as ei:
+                ckpt.wait()
+            assert ei.value.checkpoint_step == 0
+        finally:
+            checkpoint.install_write_fault_hook(prev)
+        # Nothing was published; the failed write cost one interval.
+        assert checkpoint.latest_complete_step(tmp_path) is None
+
+    def test_error_delivered_at_next_save_costs_one_interval(
+            self, tmp_path, eight_devices):
+        """save_async raises the PREVIOUS save's error only after the new
+        snapshot is in flight — one transient fault loses exactly one
+        checkpoint, never two."""
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0)
+        fired = []
+
+        def boom_once(stage, step):
+            if not fired:
+                fired.append(step)
+                raise OSError("injected transient failure")
+
+        prev = checkpoint.install_write_fault_hook(boom_once)
+        try:
+            ckpt = checkpoint.AsyncCheckpointer(tmp_path)
+            ckpt.save_async(model, step=0)
+            with pytest.raises(OSError) as ei:
+                ckpt.save_async(model, step=1)
+            assert ei.value.checkpoint_step == 0
+            path = ckpt.wait()  # step 1's write proceeds and publishes
+        finally:
+            checkpoint.install_write_fault_hook(prev)
+        assert path is not None and path.endswith("ckpt-1")
+        assert checkpoint.all_steps(tmp_path) == [1]
+
+    def test_modelcheckpoint_survives_transient_write_fault(
+            self, tmp_path, eight_devices):
+        """One failed background write must cost the checkpoint, not the
+        run: fit completes and every other epoch's step is published."""
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+
+        def boom_epoch1(stage, step):
+            if step == 1:
+                raise OSError("injected write failure for epoch 1")
+
+        prev = checkpoint.install_write_fault_hook(boom_epoch1)
+        try:
+            model.fit(_ds(), epochs=3, steps_per_epoch=2, verbose=0,
+                      callbacks=[ModelCheckpoint(tmp_path)])
+        finally:
+            checkpoint.install_write_fault_hook(prev)
+        assert checkpoint.all_steps(tmp_path) == [0, 2]
+
+    def test_latest_complete_step_skips_unpublished_stage(self, tmp_path,
+                                                          eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0)
+        checkpoint.save(tmp_path, model, step=1)
+        # A torn async attempt: a stage dir and a step dir with no manifest.
+        (tmp_path / ".stage-5").mkdir()
+        (tmp_path / ".stage-5" / "arrays-shard-0.npz").write_bytes(b"junk")
+        (tmp_path / "ckpt-7").mkdir()
+        (tmp_path / "ckpt-7" / "arrays.npz").write_bytes(b"torn")
+        assert checkpoint.all_steps(tmp_path) == [1, 7]
+        # The atomic pointer only ever names PUBLISHED steps, so the torn
+        # ckpt-7 is invisible to latest_step; latest_complete_step verifies
+        # the manifest regardless.
+        assert checkpoint.latest_step(tmp_path) == 1
+        assert checkpoint.latest_complete_step(tmp_path) == 1
+        restored, step = checkpoint.restore(tmp_path, self._template(model))
+        assert step == 1
+
+    def test_async_sharded_roundtrip(self, tmp_path, eight_devices):
+        import json
+
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=4, verbose=0)
+        ref = self._flat_host(checkpoint._saveable(model))
+        with checkpoint.AsyncCheckpointer(tmp_path, sharded=True) as ckpt:
+            ckpt.save_async(model, step=3)
+            assert ckpt.in_flight_step == 3
+        manifest = json.loads(
+            (tmp_path / "ckpt-3" / "manifest.json").read_text())
+        assert manifest["format"] == "tpu_dist.checkpoint.v2-sharded"
+        assert not (tmp_path / ".stage-3").exists()
+        restored, step = checkpoint.restore(tmp_path, self._template(model))
+        assert step == 3
+        got = self._flat_host(restored)
+        for k in ref:
+            np.testing.assert_array_equal(ref[k], got[k])
+
+    def test_context_manager_drains_without_masking_error(self, tmp_path,
+                                                          eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0)
+        with pytest.raises(RuntimeError, match="body error"):
+            with checkpoint.AsyncCheckpointer(tmp_path) as ckpt:
+                ckpt.save_async(model, step=0)
+                raise RuntimeError("body error")
+        assert ckpt.in_flight_step is None  # drained on the way out
+        assert checkpoint.all_steps(tmp_path) == [0]
+
+    def test_max_to_keep_gc_applies_to_async_saves(self, tmp_path,
+                                                   eight_devices):
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        model.fit(_ds(), epochs=1, steps_per_epoch=2, verbose=0)
+        with checkpoint.AsyncCheckpointer(tmp_path, max_to_keep=2) as ckpt:
+            for step in range(4):
+                ckpt.save_async(model, step=step)
+        assert checkpoint.all_steps(tmp_path) == [2, 3]

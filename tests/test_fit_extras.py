@@ -230,3 +230,71 @@ class TestRaggedMultiStep:
         h = model.fit(_ds(n=80, batch=32), epochs=2, verbose=0)
         assert len(h.history["loss"]) == 2
         assert all(np.isfinite(v) for v in h.history["loss"])
+
+
+class TestLazyEpochLogs:
+    """Epoch-boundary desynchronization: loss/metric scalars stay on device
+    behind one batched non-blocking transfer until something actually reads
+    them (History.history, the progress bar, a monitoring callback)."""
+
+    def test_fit_defers_epoch_fetch_until_history_read(self, eight_devices):
+        from tpu_dist.training import History, LazyLogs
+
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        h = model.fit(_ds(), epochs=2, steps_per_epoch=4, verbose=0)
+        assert isinstance(h, History)
+        # verbose=0, no log-reading callbacks: every epoch's device scalars
+        # are still pending — nothing on the epoch boundary blocked on them.
+        assert len(h._pending) == 2
+        assert all(isinstance(logs, LazyLogs) and logs._device
+                   for logs in h._pending)
+        hist = h.history  # first read drains and materializes
+        assert not h._pending
+        assert len(hist["loss"]) == 2 and len(hist["epoch_time"]) == 2
+        assert all(isinstance(v, float) for v in hist["loss"])
+        assert all(isinstance(v, float) for v in hist["accuracy"])
+
+    def test_lazylogs_key_queries_do_not_materialize(self, eight_devices):
+        import jax.numpy as jnp
+
+        from tpu_dist.training import LazyLogs
+
+        logs = LazyLogs({"epoch_time": 0.5}, {"loss": jnp.float32(2.0)})
+        assert "loss" in logs and "epoch_time" in logs
+        assert len(logs) == 2 and sorted(logs) == ["epoch_time", "loss"]
+        assert logs._device  # still pending after key/len/contains reads
+        assert logs["loss"] == 2.0  # value read materializes...
+        assert not logs._device  # ...everything, in one batch
+        assert isinstance(dict.__getitem__(logs, "loss"), float)
+
+    def test_absorb_merges_without_forcing_fetch(self, eight_devices):
+        import jax.numpy as jnp
+
+        from tpu_dist.training import LazyLogs
+
+        logs = LazyLogs({"epoch_time": 0.1}, {"loss": jnp.float32(1.0)})
+        val = LazyLogs(device_logs={"loss": jnp.float32(3.0),
+                                    "accuracy": jnp.float32(0.5)})
+        logs.absorb(val, prefix="val_")
+        assert val._device and logs._device  # both still pending
+        assert logs.get("val_loss") == 3.0
+        assert logs["val_accuracy"] == 0.5
+        assert logs["loss"] == 1.0
+
+    def test_monitoring_callbacks_see_correct_values(self, eight_devices):
+        """EarlyStopping-style consumers read through get(): the lazy logs
+        must hand them the same numbers a sync fetch would."""
+        seen = []
+        from tpu_dist.training import LambdaCallback
+
+        s = td.MirroredStrategy()
+        with s.scope():
+            model = _model()
+        h = model.fit(
+            _ds(), epochs=3, steps_per_epoch=4, verbose=0,
+            callbacks=[LambdaCallback(
+                on_epoch_end=lambda e, logs: seen.append(
+                    float(logs.get("loss"))))])
+        assert seen == pytest.approx(h.history["loss"])

@@ -48,6 +48,16 @@ class TestFaultPlanParsing:
         assert (slow.seconds, slow.count) == (0.25, 4)
         assert hang.attempt is None  # fires on every restart attempt
 
+    def test_compact_kill_during_save_and_aliases(self):
+        plan = FaultPlan.parse(
+            "kill-during-save@epoch2:attempt1, ckpt-kill@epoch0")
+        a, b = plan.faults
+        assert (a.kind, a.epoch, a.attempt) == ("kill_during_save", 2, 1)
+        assert (b.kind, b.epoch, b.attempt) == ("kill_during_save", 0, 0)
+        assert a.exit_code == EXIT_FAULT_KILL
+        # JSON roundtrip keeps the canonical kind.
+        assert FaultPlan.from_json(plan.to_json()) == plan
+
     def test_json_roundtrip_is_identity(self):
         plan = FaultPlan.parse("kill@step5:rank1, ckpt-fail@epoch2:truncate")
         assert FaultPlan.parse(plan.dumps()) == plan
@@ -263,24 +273,32 @@ class TestChaosCli:
         assert main(["--plan", "  "]) == 2
 
     def test_kill_worker_chaos_run_end_to_end(self, tmp_path):
-        """The acceptance demo: kill at global step 5, supervised restart,
-        resume from the last complete checkpoint, loss parity vs the
-        uninterrupted baseline."""
+        """The acceptance demo (scripts/check.sh resilience-smoke): kill at
+        global step 5 on attempt 0, then on the restarted attempt kill again
+        from INSIDE the checkpoint write seam while the epoch-2 async save
+        is staged but unpublished. Recovery must come from the last
+        PUBLISHED step (never the torn stage) and the final attempt must
+        reach loss parity with the uninterrupted baseline."""
         report_path = tmp_path / "report.json"
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         proc = subprocess.run(
             [sys.executable, "-m", "tpu_dist.resilience",
-             "--plan", "kill-worker@step5",
+             "--plan", "kill-worker@step5,kill-during-save@epoch2:attempt1",
              "--workdir", str(tmp_path / "chaos"),
              "--report", str(report_path)],
-            capture_output=True, text=True, timeout=300,
+            capture_output=True, text=True, timeout=420,
             cwd=str(REPO_ROOT), env=env)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         report = json.loads(report_path.read_text())
         assert report["ok"] and report["success"]
-        assert report["restarts"] >= 1
+        assert report["restarts"] >= 2
         assert report["exit_codes"][0] == [EXIT_FAULT_KILL]
-        assert [f["kind"] for f in report["faults_fired"]] == ["kill"]
+        assert report["exit_codes"][1] == [EXIT_FAULT_KILL]
+        assert sorted(f["kind"] for f in report["faults_fired"]) == [
+            "kill", "kill_during_save"]
+        # Attempt 2 resumed from epoch 1 — the last step PUBLISHED before
+        # the mid-save kill tore epoch 2's stage.
+        assert report["resumed_from"][-1] == 1
         assert report["parity_ok"]
         assert abs(report["loss_delta"]) <= 1e-5
         kinds = [e["event"] for e in read_events(

@@ -507,6 +507,28 @@ def _trace_moe_layer():
             lambda p, xx: layer.apply(p, state, xx)[0])(params, x)
 
 
+def _trace_checkpoint_snapshot():
+    """The async checkpointer's on-device snapshot program
+    (training/checkpoint.py: ``snapshot_copy_program``) over a compiled
+    trainer's saveable state. Pins the zero-stall contract: the snapshot a
+    save dispatches on the training thread must stay collective-free — any
+    gather/reduce sneaking into it would put the background writer in the
+    collective ordering and deadlock against the main thread's barriers —
+    and its HBM cost is the transient double-buffer the pipeline budgets."""
+    import jax
+
+    from tpu_dist.models import Dense, Sequential
+    from tpu_dist.training import checkpoint
+    from tpu_dist.training.trainer import Trainer
+
+    model = Sequential([Dense(4)], input_shape=(4,), name="shardcheck_probe")
+    model.compile(optimizer="sgd", loss="mse")
+    trainer = Trainer(model)
+    trainer.ensure_variables()
+    saveable = checkpoint._saveable(trainer.variables)
+    return jax.make_jaxpr(checkpoint.snapshot_copy_program)(saveable)
+
+
 ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
@@ -516,6 +538,7 @@ ENTRY_POINTS = {
     "parallel.tensor.megatron_block": _trace_megatron_block,
     "parallel.sequence.ring_attention": _trace_ring_attention,
     "parallel.expert.moe_layer": _trace_moe_layer,
+    "training.checkpoint.snapshot_copy": _trace_checkpoint_snapshot,
 }
 
 #: Argument positions each entry point's production caller donates

@@ -176,6 +176,12 @@ def main(argv: Optional[list] = None) -> int:
             {k: r.get(k) for k in ("kind", "at", "step", "op", "mode")
              if r.get(k) is not None} for r in fired],
         "events": len(events.read_events(event_path)),
+        # Which checkpoint step each restarted attempt resumed from, in
+        # order — the proof that recovery came from the last PUBLISHED step
+        # (a kill_during_save run must show the pre-kill step here, never
+        # the step whose save was torn mid-flight).
+        "resumed_from": [r.get("step") for r in
+                         events.read_events(event_path, "checkpoint_resume")],
         "final_loss": (final or {}).get("final_loss"),
     }
     # Per-rank telemetry (the workers run with TPU_DIST_OBSERVE_DIR armed,

@@ -35,6 +35,14 @@ Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
     Transiently fail (``mode="transient"``) or corrupt (``mode="truncate"``)
     checkpoint writes through the seam in
     :mod:`tpu_dist.training.checkpoint`.
+``kill_during_save``
+    ``os._exit(exit_code)`` from inside the checkpoint write seam — the
+    process dies with a checkpoint staged but NOT yet published. With the
+    async pipeline the seam fires on the background writer thread while
+    training is mid-epoch, so this is the deterministic "preempted during an
+    in-flight async save" scenario: recovery must come from the last
+    *published* step, never the torn stage. Targets the CHECKPOINT's step
+    coordinate (``@epochN`` for ModelCheckpoint's per-epoch saves).
 ``slow_input``
     Sleep at host batch boundaries — a straggling input pipeline.
 """
@@ -50,7 +58,7 @@ from typing import Optional, Sequence
 #: Canonical fault kinds. CLI aliases (kill-worker, ckpt-fail, ...) normalize
 #: onto these names.
 KINDS = ("kill", "delay_collective", "hang_collective", "checkpoint_fail",
-         "slow_input")
+         "kill_during_save", "slow_input")
 
 _ALIASES = {
     "kill-worker": "kill",
@@ -60,6 +68,8 @@ _ALIASES = {
     "ckpt-fail": "checkpoint_fail",
     "ckpt_fail": "checkpoint_fail",
     "checkpoint-fail": "checkpoint_fail",
+    "kill-during-save": "kill_during_save",
+    "ckpt-kill": "kill_during_save",
     "slow-input": "slow_input",
 }
 

@@ -11,7 +11,12 @@ reach:
   (barriers, chief broadcasts, host reductions) stall as if the fabric did;
 * :func:`tpu_dist.training.checkpoint.install_write_fault_hook` for
   ``checkpoint_fail`` — a staged-but-unpublished checkpoint write either
-  raises (``transient``) or is corrupted in place (``truncate``).
+  raises (``transient``) or is corrupted in place (``truncate``) — and for
+  ``kill_during_save`` — ``os._exit`` from inside the seam, i.e. a death
+  with the checkpoint staged but unpublished. Under the async pipeline the
+  seam runs on the background writer thread (``os._exit`` kills the whole
+  process regardless of thread), making this the deterministic mid-async-
+  save preemption.
 
 Step accounting: ``on_batch_end(step, logs)`` fires once per compiled
 execution with the in-epoch step index; the injector tracks the GLOBAL step
@@ -76,7 +81,8 @@ class FaultInjector(Callback):
 
             self._prev_collective_hook = collectives.install_fault_hook(
                 self._collective_hook)
-        if any(f.kind == "checkpoint_fail" for f in self.faults):
+        if any(f.kind in ("checkpoint_fail", "kill_during_save")
+               for f in self.faults):
             from tpu_dist.training import checkpoint
 
             self._prev_write_hook = checkpoint.install_write_fault_hook(
@@ -97,7 +103,8 @@ class FaultInjector(Callback):
             from tpu_dist.parallel import collectives
 
             collectives.install_fault_hook(self._prev_collective_hook)
-        if any(f.kind == "checkpoint_fail" for f in self.faults):
+        if any(f.kind in ("checkpoint_fail", "kill_during_save")
+               for f in self.faults):
             from tpu_dist.training import checkpoint
 
             checkpoint.install_write_fault_hook(self._prev_write_hook)
@@ -161,14 +168,28 @@ class FaultInjector(Callback):
             self._prev_collective_hook(op)
 
     def _write_hook(self, stage_dir, step: int) -> None:
+        # ``step`` here is the CHECKPOINT's step coordinate (the epoch number
+        # for ModelCheckpoint's per-epoch saves), matched against the fault's
+        # epoch when one is given. Under the async pipeline this hook runs on
+        # the background writer thread — fine for both effects (raising is
+        # delivered at the next commit point; os._exit is process-wide).
         for i, f in enumerate(self.faults):
-            if f.kind != "checkpoint_fail" or self._remaining[i] <= 0:
+            if (f.kind not in ("checkpoint_fail", "kill_during_save")
+                    or self._remaining[i] <= 0):
                 continue
             due = (f.due_at_epoch(step) if f.epoch is not None
                    else f.due_at_step(step))
             if not due:
                 continue
             self._remaining[i] -= 1
+            if f.kind == "kill_during_save":
+                self._log("fault_fired", kind="kill_during_save", step=step,
+                          exit_code=f.exit_code)
+                logger.warning(
+                    "fault injection: killing process during checkpoint "
+                    "save of step %d (stage %s unpublished, exit %d)",
+                    step, stage_dir, f.exit_code)
+                os._exit(f.exit_code)
             self._log("fault_fired", kind="checkpoint_fail", mode=f.mode,
                       step=step)
             if f.mode == "transient":

@@ -7,19 +7,23 @@ from tpu_dist.training.callbacks import (
     History,
     JSONLogger,
     LambdaCallback,
+    LazyLogs,
     ModelCheckpoint,
     StopTraining,
     TensorBoard,
 )
+from tpu_dist.training.checkpoint import AsyncCheckpointer
 from tpu_dist.training.trainer import Trainer
 
 __all__ = [
     "checkpoint",
+    "AsyncCheckpointer",
     "Callback",
     "EarlyStopping",
     "History",
     "JSONLogger",
     "LambdaCallback",
+    "LazyLogs",
     "ModelCheckpoint",
     "StopTraining",
     "Telemetry",

@@ -16,6 +16,82 @@ from typing import Optional, Sequence
 logger = logging.getLogger("tpu_dist.callbacks")
 
 
+class LazyLogs(dict):
+    """Epoch logs whose device-resident scalars are fetched on first read.
+
+    The trainer queues the epoch's loss/metric reductions as device ops and
+    issues ONE batched non-blocking device→host transfer right at last-step
+    dispatch; materialization (a single ``jax.device_get``) happens only when
+    a consumer actually reads a value — :class:`History` at ``.history``
+    access, the progress bar when verbose, a monitoring callback via
+    ``get``/``items``. A ``verbose=0`` fit with no log-reading callbacks
+    never blocks on the epoch boundary at all.
+
+    Value reads materialize; key/len/contains queries don't (the key set is
+    known up front). Plain-dict writes (``update``/``[]=`` with host floats)
+    are unaffected. The stored device scalars are NEVER donated by later
+    steps (the trainer re-creates metric states each epoch), so deferred
+    reads stay valid for the life of the History object.
+    """
+
+    def __init__(self, host_logs: Optional[dict] = None,
+                 device_logs: Optional[dict] = None):
+        super().__init__(host_logs or {})
+        self._device = dict(device_logs or {})
+        for v in self._device.values():
+            if hasattr(v, "copy_to_host_async"):
+                v.copy_to_host_async()
+        # Pending values are visible (as device scalars) to dict-bypass
+        # readers like dict(logs); float() on them still works, it just
+        # blocks — the override surface below is the non-blocking contract.
+        super().update(self._device)
+
+    def materialize(self) -> "LazyLogs":
+        """Fetch every pending device value in one batched transfer and
+        replace it with a plain float; idempotent."""
+        if self._device:
+            import jax
+
+            fetched = jax.device_get(self._device)
+            self._device = {}
+            super().update({k: float(v) for k, v in fetched.items()})
+        return self
+
+    def absorb(self, other: dict, prefix: str = "") -> None:
+        """Merge ``other``'s entries under ``prefix`` WITHOUT forcing a
+        fetch: another LazyLogs' pending device values stay pending (this is
+        how validation logs fold into the epoch logs lazily)."""
+        if isinstance(other, LazyLogs):
+            for k, v in other._device.items():
+                self._device[prefix + k] = v
+        for k, v in dict.items(other):
+            dict.__setitem__(self, prefix + k, v)
+
+    def __getitem__(self, key):
+        self.materialize()
+        return super().__getitem__(key)
+
+    def get(self, key, default=None):
+        self.materialize()
+        return super().get(key, default)
+
+    def items(self):
+        self.materialize()
+        return super().items()
+
+    def values(self):
+        self.materialize()
+        return super().values()
+
+    def copy(self) -> dict:
+        self.materialize()
+        return dict(self)
+
+    def __repr__(self):
+        self.materialize()
+        return super().__repr__()
+
+
 class Callback:
     model = None  # wired by CallbackList
 
@@ -45,7 +121,13 @@ class CallbackList:
             cb.on_train_begin()
 
     def on_train_end(self):
-        for cb in self.callbacks:
+        # Teardown runs in REVERSE registration order (proper nesting):
+        # later-registered callbacks may own in-flight work whose completion
+        # earlier ones' teardown must still observe — e.g. ModelCheckpoint
+        # (appended last by fit) drains its async checkpoint writer while the
+        # FaultInjector's write-fault hook and the Telemetry registry are
+        # still installed.
+        for cb in reversed(self.callbacks):
             cb.on_train_end()
 
     def on_epoch_begin(self, epoch):
@@ -63,31 +145,67 @@ class CallbackList:
 
 
 class History(Callback):
-    """Per-epoch log record; ``fit`` returns this (Keras History analog)."""
+    """Per-epoch log record; ``fit`` returns this (Keras History analog).
+
+    Epoch logs may be :class:`LazyLogs` still holding device scalars;
+    History stores them unread and folds them into the dict only when
+    ``.history`` is accessed — so a fit whose History is never inspected
+    never forces the epoch-boundary device→host fetch."""
 
     def __init__(self):
-        self.history: dict[str, list] = {}
         self.epoch: list[int] = []
+        self._pending: list[dict] = []
+        self._history: dict[str, list] = {}
 
     def on_epoch_end(self, epoch, logs):
         self.epoch.append(epoch)
-        for k, v in logs.items():
-            self.history.setdefault(k, []).append(v)
+        self._pending.append(logs)
+
+    @property
+    def history(self) -> dict[str, list]:
+        while self._pending:
+            logs = self._pending.pop(0)
+            if isinstance(logs, LazyLogs):
+                logs.materialize()
+            for k, v in logs.items():
+                self._history.setdefault(k, []).append(v)
+        return self._history
 
 
 class ModelCheckpoint(Callback):
     """Chief-only checkpoint writes each epoch (README.md:51 semantics:
-    'the chief saves checkpoint models')."""
+    'the chief saves checkpoint models').
+
+    ``async_save=True`` (the default) routes saves through the zero-stall
+    :class:`~tpu_dist.training.checkpoint.AsyncCheckpointer`: the epoch
+    boundary only pays the on-device snapshot; serialization/fsync/publish
+    overlap the next epoch's steps, and any write error surfaces at the next
+    epoch's save (or at train end), where it is absorbed exactly like a sync
+    failure — one lost checkpoint interval, logged as
+    ``checkpoint_write_failed``, never a dead run. ``on_train_end`` drains
+    the writer, so fit never returns with a save still in flight."""
 
     def __init__(self, directory: str, *, save_best_only: bool = False,
                  monitor: str = "loss", mode: str = "min",
-                 max_to_keep: Optional[int] = None):
+                 max_to_keep: Optional[int] = None, async_save: bool = True,
+                 sharded: bool = False):
         self.directory = directory
         self.save_best_only = save_best_only
         self.monitor = monitor
         self.mode = mode
         self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self.sharded = sharded
         self._best: Optional[float] = None
+        self._ckpt = None
+
+    def on_train_begin(self):
+        if self.async_save and self._ckpt is None:
+            from tpu_dist.training import checkpoint
+
+            self._ckpt = checkpoint.AsyncCheckpointer(
+                self.directory, max_to_keep=self.max_to_keep,
+                sharded=self.sharded)
 
     def on_epoch_end(self, epoch, logs):
         from tpu_dist.training import checkpoint
@@ -105,17 +223,33 @@ class ModelCheckpoint(Callback):
                 return
             self._best = current
         try:
-            checkpoint.save(self.directory, self.model, step=epoch,
-                            max_to_keep=self.max_to_keep)
+            if self._ckpt is not None:
+                self._ckpt.save_async(self.model, step=epoch)
+            else:
+                checkpoint.save(self.directory, self.model, step=epoch,
+                                max_to_keep=self.max_to_keep,
+                                sharded=self.sharded)
         except OSError as exc:
-            # A failed write costs one checkpoint interval, never the run:
-            # training state is still live, and the next epoch retries.
-            logger.warning("ModelCheckpoint: step %d write failed (%s); "
-                           "continuing without it", epoch, exc)
-            from tpu_dist.resilience import events
+            self._write_failed(getattr(exc, "checkpoint_step", epoch), exc)
 
-            events.maybe_log("checkpoint_write_failed", step=epoch,
-                             error=str(exc))
+    def on_train_end(self):
+        if self._ckpt is None:
+            return
+        ckpt, self._ckpt = self._ckpt, None
+        try:
+            ckpt.close()
+        except OSError as exc:
+            self._write_failed(getattr(exc, "checkpoint_step", None), exc)
+
+    def _write_failed(self, step, exc) -> None:
+        # A failed write costs one checkpoint interval, never the run:
+        # training state is still live, and the next epoch retries.
+        logger.warning("ModelCheckpoint: step %s write failed (%s); "
+                       "continuing without it", step, exc)
+        from tpu_dist.resilience import events
+
+        events.maybe_log("checkpoint_write_failed", step=step,
+                         error=str(exc))
 
 
 class EarlyStopping(Callback):

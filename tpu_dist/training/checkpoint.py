@@ -4,10 +4,24 @@ The reference specifies the capability in prose only — the chief's duties
 include "saving checkpoint models" (README.md:51); the example itself never
 saves. Parity target: chief-only checkpoint + resume-from-latest, not a format
 zoo. Format: one ``.npz`` of flattened arrays + a JSON manifest per step,
-written atomically (temp + rename), with a ``checkpoint`` pointer file naming
-the latest step — restore on every process, then a broadcast from process 0
-guarantees bit-identical restored state cluster-wide (the D4 init-broadcast
-rule applied to resume; divergence-free restore is SURVEY.md hard-part #3).
+written atomically (temp + rename) and durably (fsync before the rename, the
+parent directory after), with a ``checkpoint`` pointer file naming the latest
+step — restore on every process, then a broadcast from process 0 guarantees
+bit-identical restored state cluster-wide (the D4 init-broadcast rule applied
+to resume; divergence-free restore is SURVEY.md hard-part #3).
+
+Two write pipelines share the formats:
+
+* :func:`save` — synchronous; the whole gather/serialize/fsync/publish
+  sequence runs on the caller's critical path (``Model.save_weights``).
+* :class:`AsyncCheckpointer` — the zero-stall pipeline (CheckFreq, Mohan et
+  al. FAST '21; Orbax's async checkpointer): a *snapshot* phase copies the
+  variable tree on-device (one async jit dispatch) and starts non-blocking
+  device→host transfers, then a background thread serializes, fsyncs and
+  atomically publishes while training continues. Barriers and error delivery
+  move to a bounded *commit point* — the next ``save_async``, ``wait()`` or
+  ``close()`` — so at most one snapshot is in flight and a failed write
+  still fails the run, one checkpoint interval late at most.
 """
 
 from __future__ import annotations
@@ -16,13 +30,17 @@ import json
 import logging
 import os
 import pathlib
+import shutil
 import tempfile
+import threading
+import time
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
 from tpu_dist.cluster import bootstrap
+from tpu_dist.observe import metrics as metrics_lib
 
 logger = logging.getLogger("tpu_dist.checkpoint")
 
@@ -80,11 +98,35 @@ def _needs_gather(tree) -> bool:
     return any(_needs_allgather(l) for l in jax.tree_util.tree_leaves(tree))
 
 
+def _join_gathers(tree) -> None:
+    """Non-chief side of a v1 save: join each cross-process allgather the
+    chief's flatten will issue, discarding the results."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if _needs_allgather(leaf):
+            _to_host(leaf)
+
+
 def _flatten(tree) -> dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         key = jax.tree_util.keystr(path)
         flat[key] = _to_host(leaf)
+    return flat
+
+
+def _flatten_local(tree) -> dict[str, np.ndarray]:
+    """:func:`_flatten` for snapshot trees: every leaf is a host array or a
+    fully readable device copy, so no collective can fire — the invariant
+    that lets the background writer call this off the main thread (the main
+    thread owns all collectives; a gather here would interleave with the
+    step stream's and deadlock the cluster)."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if _needs_allgather(leaf):
+            raise ValueError(
+                f"snapshot leaf {jax.tree_util.keystr(path)!r} still spans "
+                "non-addressable devices; snapshot phase must gather it")
+        flat[jax.tree_util.keystr(path)] = np.asarray(leaf)
     return flat
 
 
@@ -109,13 +151,60 @@ def _step_dir(directory: pathlib.Path, step: int) -> pathlib.Path:
     return directory / f"ckpt-{step}"
 
 
+def _saveable(model_or_variables) -> dict:
+    variables = getattr(model_or_variables, "variables", model_or_variables)
+    if variables is None:
+        raise ValueError("model has no materialized variables to save; "
+                         "run fit() or ensure_variables() first")
+    return {k: variables[k] for k in ("params", "state", "opt")
+            if k in variables}
+
+
+# -- durability helpers -------------------------------------------------------
+# os.replace makes the publish ATOMIC, but atomicity is not DURABILITY: after
+# a crash right behind the rename, the npz/manifest data pages — or the rename
+# record itself — may still sit in the page cache, leaving the pointer naming
+# a torn step on a journal replay. The classic create→fsync(files)→rename→
+# fsync(parent dir) sequence closes that window on both layouts.
+
+def _fsync(path: pathlib.Path) -> None:
+    """fsync a file or directory by path (directories need an fd too)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _publish_stage(stage: pathlib.Path, target: pathlib.Path,
+                   directory: pathlib.Path, step: int) -> None:
+    """Durably publish a fully staged checkpoint directory (chief only):
+    fsync every staged file + the stage dir, rename into place, fsync the
+    parent, then atomically update the ``checkpoint`` pointer."""
+    for child in sorted(stage.iterdir()):
+        if child.is_file():
+            _fsync(child)
+    _fsync(stage)
+    if target.exists():
+        shutil.rmtree(target)
+    os.replace(stage, target)
+    _fsync(directory)
+    pointer_tmp = directory / (_POINTER + ".tmp")
+    pointer_tmp.write_text(str(step))
+    _fsync(pointer_tmp)
+    os.replace(pointer_tmp, directory / _POINTER)
+    _fsync(directory)
+
+
 #: Fault-injection seam (tpu_dist.resilience): called on the chief with the
-#: fully staged checkpoint directory right before the atomic publish. A hook
-#: may raise OSError (a transient write failure — the stage is discarded and
-#: nothing is published) or corrupt the staged files in place (simulating a
-#: mid-write crash on a filesystem whose rename is not atomic); restore-side
-#: manifest validation must then reject the published step. None in
-#: production — one pointer check per save.
+#: fully staged checkpoint directory right before the atomic publish (for
+#: async saves this happens on the background writer thread — which is what
+#: lets a ``kill_during_save`` fault land deterministically mid-flight). A
+#: hook may raise OSError (a transient write failure — the stage is discarded
+#: and nothing is published) or corrupt the staged files in place (simulating
+#: a mid-write crash on a filesystem whose rename is not atomic);
+#: restore-side manifest validation must then reject the published step.
+#: None in production — one pointer check per save.
 _WRITE_FAULT_HOOK = None
 
 
@@ -133,11 +222,35 @@ def _fire_write_fault(stage: pathlib.Path, step: int) -> None:
         _WRITE_FAULT_HOOK(stage, step)
 
 
+def _write_v1_checkpoint(directory: pathlib.Path, flat: dict,
+                         *, step: int, max_to_keep: Optional[int]) -> str:
+    """Serialize + durably publish one v1 checkpoint from host arrays (chief
+    only). Shared by the sync path and the async writer thread; contains no
+    collectives and no barriers."""
+    directory.mkdir(parents=True, exist_ok=True)
+    target = _step_dir(directory, step)
+    with tempfile.TemporaryDirectory(dir=directory) as tmp:
+        tmp_path = pathlib.Path(tmp) / "stage"
+        tmp_path.mkdir()
+        np.savez(tmp_path / _ARRAYS, **flat)
+        (tmp_path / _MANIFEST).write_text(json.dumps({
+            "step": step,
+            "keys": sorted(flat),
+            "format": _FORMAT_V1,
+        }))
+        _fire_write_fault(tmp_path, step)
+        _publish_stage(tmp_path, target, directory, step)
+    logger.info("checkpoint step %d written to %s", step, target)
+    if max_to_keep is not None:
+        _gc(directory, max_to_keep)
+    return str(target)
+
+
 def save(directory: str | os.PathLike, model_or_variables, *, step: int,
          max_to_keep: Optional[int] = None,
          sharded: bool = False) -> Optional[str]:
-    """Write checkpoint ``step``; returns its path (None on non-chief
-    unless ``sharded``).
+    """Write checkpoint ``step`` synchronously; returns its path (None on
+    non-chief unless ``sharded``).
 
     Accepts a compiled Model (saves its live training variables) or a raw
     variables pytree. Only the chief writes (README.md:51); all processes
@@ -154,63 +267,44 @@ def save(directory: str | os.PathLike, model_or_variables, *, step: int,
     checkpoint contract); restore re-places onto whatever mesh is current,
     so cross-topology moves work exactly like v1.
     """
-    variables = getattr(model_or_variables, "variables", model_or_variables)
-    if variables is None:
-        raise ValueError("model has no materialized variables to save; "
-                         "run fit() or ensure_variables() first")
-    saveable = {k: variables[k] for k in ("params", "state", "opt")
-                if k in variables}
+    t0 = time.perf_counter()
+    saveable = _saveable(model_or_variables)
     directory = pathlib.Path(directory)
-    if sharded:
-        return _save_sharded(directory, saveable, step=step,
-                             max_to_keep=max_to_keep)
-    path = None
-    # Tensor-parallel leaves require a cross-process allgather (a collective),
-    # so non-chief processes must JOIN each gather — but only the gathers:
-    # they walk the same leaf order the chief's _flatten does and discard the
-    # results, paying nothing for replicated leaves. Pure-DP saves keep their
-    # old shape (chief-only host copy, peers untouched).
-    if _needs_gather(saveable) and not bootstrap.is_chief():
-        for leaf in jax.tree_util.tree_leaves(saveable):
-            if _needs_allgather(leaf):
-                _to_host(leaf)
-    write_error: Optional[OSError] = None
-    if bootstrap.is_chief():
-        directory.mkdir(parents=True, exist_ok=True)
-        target = _step_dir(directory, step)
-        flat = _flatten(saveable)
-        # Atomic publish: stage into a temp dir, then rename into place.
-        # A write failure (real, or injected through the fault seam) must
-        # not skip the closing barrier — peers are already waiting there,
-        # so raising early would trade a lost checkpoint for a cluster-wide
-        # hang. Record, rendezvous, then propagate.
-        try:
-            with tempfile.TemporaryDirectory(dir=directory) as tmp:
-                tmp_path = pathlib.Path(tmp) / "stage"
-                tmp_path.mkdir()
-                np.savez(tmp_path / _ARRAYS, **flat)
-                (tmp_path / _MANIFEST).write_text(json.dumps({
-                    "step": step,
-                    "keys": sorted(flat),
-                    "format": _FORMAT_V1,
-                }))
-                _fire_write_fault(tmp_path, step)
-                if target.exists():
-                    import shutil
-
-                    shutil.rmtree(target)
-                os.replace(tmp_path, target)
-            (directory / _POINTER).write_text(str(step))
-            path = str(target)
-            logger.info("checkpoint step %d written to %s", step, target)
-            if max_to_keep is not None:
-                _gc(directory, max_to_keep)
-        except OSError as exc:
-            write_error = exc
-    bootstrap.barrier(f"checkpoint_save_{step}")
-    if write_error is not None:
-        raise write_error
-    return path
+    try:
+        if sharded:
+            return _save_sharded(directory, saveable, step=step,
+                                 max_to_keep=max_to_keep)
+        path = None
+        # Tensor-parallel leaves require a cross-process allgather (a
+        # collective), so non-chief processes must JOIN each gather — but only
+        # the gathers: they walk the same leaf order the chief's _flatten does
+        # and discard the results, paying nothing for replicated leaves.
+        # Pure-DP saves keep their old shape (chief-only host copy, peers
+        # untouched).
+        if not bootstrap.is_chief():
+            _join_gathers(saveable)
+        write_error: Optional[OSError] = None
+        if bootstrap.is_chief():
+            # A write failure (real, or injected through the fault seam) must
+            # not skip the closing barrier — peers are already waiting there,
+            # so raising early would trade a lost checkpoint for a
+            # cluster-wide hang. Record, rendezvous, then propagate.
+            try:
+                path = _write_v1_checkpoint(directory, _flatten(saveable),
+                                            step=step, max_to_keep=max_to_keep)
+            except OSError as exc:
+                write_error = exc
+        bootstrap.barrier(f"checkpoint_save_{step}")
+        if write_error is not None:
+            raise write_error
+        return path
+    finally:
+        # Sync saves stall the step stream for their full duration — record
+        # it on the same series the async pipeline uses, so one bench/gate
+        # compares both (free when the observe registry is disabled).
+        metrics_lib.inc("checkpoint.sync_saves")
+        metrics_lib.observe_value("checkpoint.stall_s",
+                                  time.perf_counter() - t0)
 
 
 def _is_replicated(leaf) -> bool:
@@ -221,20 +315,13 @@ def _is_replicated(leaf) -> bool:
     return leaf.is_fully_replicated
 
 
-def _save_sharded(directory: pathlib.Path, saveable, *, step: int,
-                  max_to_keep: Optional[int]) -> str:
+def _write_sharded_stage(stage: pathlib.Path, saveable, *, step: int) -> None:
+    """This process's v2 stage writes: its replica-0 shards + index, plus —
+    on the chief — the replicated-leaf npz and the manifest. No collectives,
+    no barriers, no fsync (the publish fsyncs the whole stage): callable
+    from the sync path between its barriers or from an async writer thread
+    on a snapshot tree."""
     proc = bootstrap.process_index()
-    stage = directory / f".stage-{step}"
-    target = _step_dir(directory, step)
-    if bootstrap.is_chief():
-        directory.mkdir(parents=True, exist_ok=True)
-        if stage.exists():
-            import shutil
-
-            shutil.rmtree(stage)
-        stage.mkdir()
-    bootstrap.barrier(f"checkpoint_stage_{step}")
-
     # Every process: its addressable replica-0 shards of sharded leaves.
     # replica_id==0 picks exactly one owner per distinct shard index, so
     # leaves replicated over SOME axes (e.g. P('pipe') on a data x pipe
@@ -283,6 +370,19 @@ def _save_sharded(directory: pathlib.Path, saveable, *, step: int,
             "process_count": jax.process_count(),
             "leaves": meta,
         }))
+
+
+def _save_sharded(directory: pathlib.Path, saveable, *, step: int,
+                  max_to_keep: Optional[int]) -> str:
+    stage = directory / f".stage-{step}"
+    target = _step_dir(directory, step)
+    if bootstrap.is_chief():
+        directory.mkdir(parents=True, exist_ok=True)
+        if stage.exists():
+            shutil.rmtree(stage)
+        stage.mkdir()
+    bootstrap.barrier(f"checkpoint_stage_{step}")
+    _write_sharded_stage(stage, saveable, step=step)
     bootstrap.barrier(f"checkpoint_written_{step}")
     write_error: Optional[OSError] = None
     if bootstrap.is_chief():
@@ -290,12 +390,7 @@ def _save_sharded(directory: pathlib.Path, saveable, *, step: int,
         # failure must not strand peers at the closing rendezvous.
         try:
             _fire_write_fault(stage, step)
-            if target.exists():
-                import shutil
-
-                shutil.rmtree(target)
-            os.replace(stage, target)
-            (directory / _POINTER).write_text(str(step))
+            _publish_stage(stage, target, directory, step)
             logger.info(
                 "sharded checkpoint step %d written to %s (%d writers)",
                 step, target, jax.process_count())
@@ -303,13 +398,280 @@ def _save_sharded(directory: pathlib.Path, saveable, *, step: int,
                 _gc(directory, max_to_keep)
         except OSError as exc:
             write_error = exc
-            import shutil
-
             shutil.rmtree(stage, ignore_errors=True)
     bootstrap.barrier(f"checkpoint_save_{step}")
     if write_error is not None:
         raise write_error
     return str(target)
+
+
+# -- async snapshot/write pipeline (zero-stall checkpointing) -----------------
+
+def snapshot_copy_program(tree):
+    """The snapshot phase's device program: a pure tree copy, NO collectives.
+
+    Traced by shardcheck as the ``training.checkpoint.snapshot_copy`` entry
+    point to pin that invariant — a collective smuggled into the snapshot
+    would re-serialize the step stream this pipeline exists to overlap, and
+    (worse) would eventually run concurrently with the main thread's own
+    collectives."""
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+_SNAPSHOT_COPY = None
+
+
+def _snapshot_copy(tree):
+    global _SNAPSHOT_COPY
+    if _SNAPSHOT_COPY is None:
+        _SNAPSHOT_COPY = jax.jit(snapshot_copy_program)
+    return _SNAPSHOT_COPY(tree)
+
+
+def _snapshot(saveable, *, gather: bool):
+    """Capture ``saveable``'s values NOW without blocking the step stream.
+
+    Returns a same-structure pytree whose leaves are host numpy arrays or
+    freshly copied device arrays with a non-blocking device→host transfer
+    already in flight. The device copy is required for CORRECTNESS, not just
+    speed: the trainer's compiled steps donate their variable arguments, so
+    a snapshot holding references to the live arrays would be invalidated by
+    the very next step's dispatch (donation deletes the buffers even with a
+    D2H copy pending). The jit dispatch itself is async — it queues behind
+    the in-flight step and returns immediately.
+
+    ``gather=True`` (v1 layout) fetches collective-needing leaves
+    synchronously here, on the calling thread — the same allgather the sync
+    path pays, and the ONLY blocking part of a snapshot. ``gather=False``
+    (v2 layout) copies every jax leaf on-device instead; shards are read
+    locally by the writer. Either way the returned tree satisfies
+    :func:`_flatten_local`'s no-collective invariant."""
+    leaves, treedef = jax.tree_util.tree_flatten(saveable)
+    host: dict[int, np.ndarray] = {}
+    for i, leaf in enumerate(leaves):
+        if gather and _needs_allgather(leaf):
+            host[i] = _to_host(leaf)
+        elif not isinstance(leaf, jax.Array):
+            host[i] = np.asarray(leaf)
+    to_copy = [None if i in host else leaf for i, leaf in enumerate(leaves)]
+    copied = _snapshot_copy(to_copy)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if i in host:
+            out.append(host[i])
+            continue
+        c = copied[i]
+        if c.is_fully_addressable or c.is_fully_replicated:
+            c.copy_to_host_async()
+        else:
+            for shard in c.addressable_shards:
+                shard.data.copy_to_host_async()
+        out.append(c)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class AsyncCheckpointer:
+    """Zero-stall checkpoint pipeline: snapshot on-device now, write later.
+
+    ``save_async(model, step=N)`` returns after (1) *committing* the previous
+    in-flight save — the bounded commit point where the cross-process
+    barriers fire and any stored write error is raised — and (2) dispatching
+    the device-side snapshot copy plus non-blocking D2H transfers for step N.
+    Serialization, fsync and the atomic publish then run on a background
+    thread, entirely off the step stream. At most ONE snapshot is in flight;
+    ``wait()``/``close()`` are the other commit points (``ModelCheckpoint``
+    closes at ``on_train_end``, so a fit never exits with an unpublished
+    save).
+
+    Error contract (same cost model as the sync path): a failed write costs
+    the checkpoint it was writing, never the run — the error surfaces at the
+    NEXT commit point, tagged with ``exc.checkpoint_step``, after all
+    processes have rendezvoused (the barrier-before-raise rule: raising
+    before the barrier would strand peers). The error is raised AFTER the
+    next snapshot is dispatched, so one transient fault loses exactly one
+    checkpoint interval.
+
+    Threading rules: the background writer never joins a collective or a
+    barrier — the main thread concurrently issues its own, and interleaved
+    collectives from two threads deadlock the cluster. v1: only the chief
+    has a writer; peers just rendezvous at commit. v2 (sharded): every
+    process writes its own shard in background, and the chief's publish
+    happens on the MAIN thread at commit, after the written-barrier proves
+    every shard landed.
+    """
+
+    def __init__(self, directory: str | os.PathLike, *,
+                 max_to_keep: Optional[int] = None, sharded: bool = False):
+        self.directory = pathlib.Path(directory)
+        self.max_to_keep = max_to_keep
+        self.sharded = sharded
+        self._thread: Optional[threading.Thread] = None
+        self._pending_step: Optional[int] = None
+        self._error: Optional[BaseException] = None  # writer → commit point
+        self._last_path: Optional[str] = None
+
+    @property
+    def in_flight_step(self) -> Optional[int]:
+        """Step currently snapshot-ed/writing, or None when drained."""
+        return self._pending_step
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            self._drain()  # already unwinding: don't mask the original error
+
+    def save_async(self, model_or_variables, *, step: int) -> None:
+        """Commit the previous save, snapshot the current state, and hand it
+        to the background writer. Raises the PREVIOUS save's stored error
+        (if any) after the new snapshot is safely in flight."""
+        t0 = time.perf_counter()
+        prev_error = self._drain()
+        saveable = _saveable(model_or_variables)
+        t_snap = time.perf_counter()
+        if self.sharded:
+            self._begin_sharded(saveable, step)
+        else:
+            self._begin_v1(saveable, step)
+        now = time.perf_counter()
+        metrics_lib.inc("checkpoint.async_saves")
+        metrics_lib.set_gauge("checkpoint.inflight", 1.0)
+        metrics_lib.observe_value("checkpoint.snapshot_s", now - t_snap)
+        metrics_lib.observe_value("checkpoint.stall_s", now - t0)
+        if prev_error is not None:
+            raise prev_error
+
+    def wait(self) -> Optional[str]:
+        """Commit point: join the in-flight write, rendezvous, raise any
+        stored error. Returns the last successfully published path (chief;
+        None on non-chief v1 processes or before the first publish)."""
+        error = self._drain()
+        if error is not None:
+            raise error
+        return self._last_path
+
+    def close(self) -> Optional[str]:
+        """Drain and commit; alias of :meth:`wait` (the checkpointer stays
+        usable afterwards — "closed" means "nothing left in flight")."""
+        return self.wait()
+
+    # -- snapshot/dispatch phase (main thread) --------------------------------
+
+    def _begin_v1(self, saveable, step: int) -> None:
+        if not bootstrap.is_chief():
+            # Peers only join the chief's gathers; their commit-point barrier
+            # is the sole remaining rendezvous.
+            _join_gathers(saveable)
+            self._pending_step = step
+            return
+        snap = _snapshot(saveable, gather=True)
+        self._pending_step = step
+        self._spawn(self._write_v1, snap, step)
+
+    def _begin_sharded(self, saveable, step: int) -> None:
+        stage = self.directory / f".stage-{step}"
+        # The chief clears any torn stage left by a crashed earlier attempt
+        # (a resume can re-save the same step) before anyone writes into it —
+        # the one rendezvous the sharded snapshot phase pays.
+        if bootstrap.is_chief():
+            self.directory.mkdir(parents=True, exist_ok=True)
+            if stage.exists():
+                shutil.rmtree(stage)
+            stage.mkdir()
+        bootstrap.barrier(f"checkpoint_stage_{step}")
+        snap = _snapshot(saveable, gather=False)
+        self._pending_step = step
+        self._spawn(self._write_sharded, snap, stage, step)
+
+    def _spawn(self, fn, *args) -> None:
+        self._thread = threading.Thread(
+            target=fn, args=args, daemon=True,
+            name=f"tpu-dist-ckpt-writer-{args[-1]}")
+        self._thread.start()
+
+    # -- writer phase (background thread; no collectives, no barriers) -------
+
+    def _write_v1(self, snap, step: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._last_path = _write_v1_checkpoint(
+                self.directory, _flatten_local(snap), step=step,
+                max_to_keep=self.max_to_keep)
+        except Exception as exc:  # delivered at the next commit point
+            self._error = exc
+        finally:
+            metrics_lib.observe_value("checkpoint.write_s",
+                                      time.perf_counter() - t0)
+
+    def _write_sharded(self, snap, stage: pathlib.Path, step: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            _write_sharded_stage(stage, snap, step=step)
+        except Exception as exc:  # delivered at the next commit point
+            self._error = exc
+        finally:
+            metrics_lib.observe_value("checkpoint.write_s",
+                                      time.perf_counter() - t0)
+
+    # -- commit phase (main thread) -------------------------------------------
+
+    def _drain(self) -> Optional[BaseException]:
+        """Join the writer, run the commit-point barrier protocol, and
+        RETURN (not raise) any error so callers choose when to surface it."""
+        if self._pending_step is None:
+            return None
+        t0 = time.perf_counter()
+        step, self._pending_step = self._pending_step, None
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        error, self._error = self._error, None
+        if self.sharded:
+            error = self._commit_sharded(step, error)
+        bootstrap.barrier(f"checkpoint_commit_{step}")
+        metrics_lib.set_gauge("checkpoint.inflight", 0.0)
+        metrics_lib.observe_value("checkpoint.commit_s",
+                                  time.perf_counter() - t0)
+        if error is not None:
+            metrics_lib.inc("checkpoint.write_errors")
+            error.checkpoint_step = step
+            logger.warning("async checkpoint step %d failed: %s", step, error)
+            return error
+        return None
+
+    def _commit_sharded(self, step: int,
+                        error: Optional[BaseException]) -> Optional[BaseException]:
+        stage = self.directory / f".stage-{step}"
+        target = _step_dir(self.directory, step)
+        from tpu_dist.parallel.collectives import host_all_reduce_sum
+
+        # Publish only when EVERY process staged cleanly: a torn v2 step
+        # would pass the chief's local view and only fail at restore-time
+        # assembly. A failing peer raises its own local error; the chief
+        # just withholds the publish.
+        bad = int(host_all_reduce_sum(np.int64(0 if error is None else 1)))
+        if bootstrap.is_chief():
+            if bad == 0:
+                try:
+                    _fire_write_fault(stage, step)
+                    _publish_stage(stage, target, self.directory, step)
+                    logger.info(
+                        "async sharded checkpoint step %d written to %s "
+                        "(%d writers)", step, target, jax.process_count())
+                    if self.max_to_keep is not None:
+                        _gc(self.directory, self.max_to_keep)
+                    self._last_path = str(target)
+                except OSError as exc:
+                    error = exc
+                    shutil.rmtree(stage, ignore_errors=True)
+            else:
+                shutil.rmtree(stage, ignore_errors=True)
+        return error
 
 
 def _manifest(target: pathlib.Path) -> dict:
@@ -380,8 +742,6 @@ def _iter_sharded_leaves(target: pathlib.Path):
 def _gc(directory: pathlib.Path, max_to_keep: int) -> None:
     steps = sorted(all_steps(directory))
     for old in steps[:-max_to_keep]:
-        import shutil
-
         shutil.rmtree(_step_dir(directory, old), ignore_errors=True)
 
 
@@ -481,7 +841,10 @@ def latest_complete_step(directory: str | os.PathLike) -> Optional[int]:
     """The newest step that passes :func:`validate_step_dir` — the resume
     anchor. The pointer file is a hint, not an authority: a fault between
     publish and pointer update (or a corrupt published step) must cost at
-    most one checkpoint interval, never the whole run."""
+    most one checkpoint interval, never the whole run. Unpublished async
+    stages (``.stage-N`` dirs, temp dirs) never match the ``ckpt-`` step
+    pattern, so a save that died in flight is invisible here by
+    construction."""
     directory = pathlib.Path(directory)
     pointed = latest_step(directory)
     if pointed is not None and is_complete(directory, pointed):
