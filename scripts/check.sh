@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # Repo gate: shardcheck static analysis, the resilience smoke chaos run,
-# the observe telemetry smoke/bench, the checkpoint stall bench, then the
-# tier-1 test suite.
+# the observe telemetry smoke/bench, the checkpoint stall bench, the
+# serve load bench, then the tier-1 test suite.
 #
 # Usage: scripts/check.sh
 #
@@ -72,6 +72,17 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/checkpoint_bench.py \
   >/dev/null \
   || { echo "check.sh: checkpoint bench gates failed" \
        "(see BENCH_CHECKPOINT.json)" >&2; exit 1; }
+
+echo "== serve-bench: continuous vs static batching throughput =="
+# Drives the identical seeded backlog through a continuous-batching and a
+# static-batching ServeEngine (warmup pass compiles every bucket first);
+# writes BENCH_SERVE.json. Gates: every request completed in BOTH modes
+# (non-vacuity), continuous throughput >= 1.05x static, and continuous
+# p99 request latency within the fixed target.
+timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/serve_bench.py \
+  >/dev/null \
+  || { echo "check.sh: serve bench gates failed (see BENCH_SERVE.json)" >&2
+       exit 1; }
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

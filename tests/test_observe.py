@@ -202,6 +202,12 @@ class TestExporters:
         assert "tpu_dist_epoch_steps_per_s 12.5" in text
         assert 'tpu_dist_step_total_s{quantile="0.5"} 0.2' in text
         assert "tpu_dist_step_total_s_count 3" in text
+        # Every registry snapshot quantile gets a summary label — derived
+        # from SNAPSHOT_QUANTILES, not a second hardcoded list (and the
+        # flattened pNN keys stay the JSONL schema, untouched).
+        for q in metrics.SNAPSHOT_QUANTILES:
+            assert f'tpu_dist_step_total_s{{quantile="{q}"}}' in text
+        assert "# TYPE tpu_dist_step_total_s summary" in text
         # Atomic write: no leftover tmp file.
         assert list(tmp_path.glob("*.tmp*")) == []
 

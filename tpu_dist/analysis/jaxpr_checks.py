@@ -529,6 +529,59 @@ def _trace_checkpoint_snapshot():
     return jax.make_jaxpr(checkpoint.snapshot_copy_program)(saveable)
 
 
+def _serve_probe():
+    """Tiny servable LM + plan shared by the two serve tracers."""
+    import jax
+
+    from tpu_dist.models.transformer import build_transformer_lm
+    from tpu_dist.serve import kv_cache
+
+    model = build_transformer_lm(32, 16, d_model=16, depth=1, num_heads=2)
+    params = model.init(0)["params"]
+    plan = kv_cache.build_plan(model)
+    cache = kv_cache.init_cache(plan, max_batch=4, max_len=16)
+    return plan, params, cache
+
+
+def _trace_serve_prefill():
+    """``serve.kv_cache.prefill`` — the full causal pass over one padded
+    prompt that seeds a KV-cache slot. Pins that prefill stays
+    collective-free on the default strategy (request-level parallelism
+    only; a collective here would serialize admissions behind the decode
+    stream) and baselines the cache-write HBM cost."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, cache = _serve_probe()
+    tokens = jnp.zeros((8,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, t: kv_cache.prefill(plan, p, c, t, jnp.int32(5),
+                                         jnp.int32(0)))(
+        params, cache, tokens)
+
+
+def _trace_serve_decode():
+    """``serve.kv_cache.decode_step`` — one generated token per active
+    slot against the cached K/V. The steady-state serving hot loop: pins
+    it collective-free and baselines its comm/HBM so a regression (an
+    accidental all-gather of the cache, a cache-sized temporary) gates CI
+    exactly like a training-step regression."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, cache = _serve_probe()
+    tokens = jnp.zeros((4,), jnp.int32)
+    lengths = jnp.ones((4,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, t, ln: kv_cache.decode_step(plan, p, c, t, ln,
+                                                 bucket=4))(
+        params, cache, tokens, lengths)
+
+
 ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
@@ -539,6 +592,8 @@ ENTRY_POINTS = {
     "parallel.sequence.ring_attention": _trace_ring_attention,
     "parallel.expert.moe_layer": _trace_moe_layer,
     "training.checkpoint.snapshot_copy": _trace_checkpoint_snapshot,
+    "serve.prefill_step": _trace_serve_prefill,
+    "serve.decode_step": _trace_serve_decode,
 }
 
 #: Argument positions each entry point's production caller donates

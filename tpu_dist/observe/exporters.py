@@ -25,6 +25,8 @@ import time
 from pathlib import Path
 from typing import IO, Optional, Union
 
+from tpu_dist.observe import metrics as metrics_lib
+
 #: Version tag stamped into every JSONL record.
 SCHEMA = "tpu_dist.observe/v1"
 
@@ -131,7 +133,11 @@ def write_prometheus_textfile(snapshot: dict,
         # Prometheus has no native distribution type for textfiles;
         # export as a summary (quantile labels) plus _count/_sum.
         lines.append(f"# TYPE {pname} summary")
-        for q in (0.5, 0.95, 0.99):
+        # Summary quantile labels, one per registry snapshot quantile —
+        # derived from metrics.SNAPSHOT_QUANTILES so a new quantile there
+        # shows up here without a second edit (the snapshot's flattened
+        # pNN keys are the JSONL schema and stay unchanged).
+        for q in metrics_lib.SNAPSHOT_QUANTILES:
             v = stats.get(f"p{int(q * 100)}")
             if v is not None:
                 lines.append(f'{pname}{{quantile="{q}"}} {v}')

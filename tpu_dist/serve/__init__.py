@@ -1,0 +1,20 @@
+"""tpu_dist.serve — continuous-batching inference on the training mesh.
+
+The serving counterpart to ``tpu_dist.training``: a ``ServeEngine``
+compiles one decode program per padded batch bucket (plus per-padded-
+length prefill programs) over a preallocated KV cache, and a slot-based
+scheduler admits/evicts requests *between* decode steps. Latency SLO
+metrics flow through ``tpu_dist.observe``; the prefill/decode programs
+are shardcheck entry points with cost baselines. ``python -m
+tpu_dist.serve --bench`` runs the seeded load generator.
+"""
+
+from tpu_dist.serve.engine import ServeEngine
+from tpu_dist.serve.kv_cache import (DecodePlan, build_plan, decode_step,
+                                     init_cache, prefill)
+from tpu_dist.serve.scheduler import Request, Scheduler, default_buckets
+
+__all__ = [
+    "ServeEngine", "DecodePlan", "build_plan", "decode_step", "init_cache",
+    "prefill", "Request", "Scheduler", "default_buckets",
+]

@@ -1,0 +1,293 @@
+"""ServeEngine: compiled-program inference runtime on the training mesh.
+
+The engine owns the three compiled surfaces serving needs and nothing
+else — scheduling stays host-side in ``scheduler.py``, math stays in
+``kv_cache.py``:
+
+* one **prefill** program per padded prompt length (prompts pad up to a
+  power of two, so a stream of ragged prompts compiles O(log max_len)
+  programs, not O(distinct lengths));
+* one **decode** program per padded batch *bucket* (``scheduler.
+  default_buckets``): requests come and go between steps, the active
+  count maps to the smallest covering bucket, and steady-state serving
+  never retraces — the same no-retrace discipline ``Trainer.predict``
+  now follows;
+* one **slot-swap** program (traced slot indices) mirroring the
+  scheduler's compaction moves into the KV cache.
+
+Weights come from a live model's materialized variables or a
+``models/serialize.py`` saved-model directory (:meth:`ServeEngine.
+from_saved`), and are placed on the active ``Strategy``'s mesh via
+``strategy.replicate`` — the same placement training uses, so a model
+can go fit() → save → serve without leaving the mesh.
+
+Every step emits host-side observe metrics (never inside jit —
+shardcheck SC103 guards this): ``serve.request.latency_s`` /
+``serve.request.ttft_s`` / ``serve.batch.occupancy`` distributions (the
+registry's reservoir quantiles give p50/p95/p99 directly),
+``serve.queue.depth`` gauge, and ``serve.{requests.*,tokens.generated,
+decode.steps,prefills}`` counters. Arm ``$TPU_DIST_OBSERVE_DIR`` (or
+call ``metrics.enable()``) to record; disabled is free.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_dist.models.model import Sequential
+from tpu_dist.observe import metrics
+from tpu_dist.parallel.strategy import get_strategy
+from tpu_dist.serve import kv_cache
+from tpu_dist.serve.scheduler import DONE, Request, Scheduler
+
+logger = logging.getLogger(__name__)
+
+_MIN_PROMPT_PAD = 8
+
+
+def _pad_to_pow2(n: int, *, lo: int = _MIN_PROMPT_PAD, hi: int) -> int:
+    p = lo
+    while p < n:
+        p <<= 1
+    return min(p, hi)
+
+
+class ServeEngine:
+    """Continuous-batching decode loop over a fixed pool of KV slots.
+
+    Args:
+      model: a ``Sequential`` from the servable family (see
+        ``kv_cache.build_plan``). Weights are taken from the model's live
+        variables when materialized, else freshly initialized from
+        ``seed`` (the demo path).
+      max_batch: KV slots == maximum concurrent requests.
+      max_len: per-slot cache capacity (prompt + generated tokens);
+        defaults to the model's positional-table length.
+      buckets / policy: forwarded to :class:`Scheduler`.
+      temperature: 0 = greedy argmax; > 0 samples from the tempered
+        softmax with a host-side seeded generator (deterministic runs).
+      clock: injectable monotonic clock (tests pin deadlines with it).
+    """
+
+    def __init__(self, model: Sequential, *, max_batch: int = 8,
+                 max_len: Optional[int] = None,
+                 buckets: Optional[tuple[int, ...]] = None,
+                 policy: str = "continuous", temperature: float = 0.0,
+                 seed: int = 0, cache_dtype=jnp.float32, clock=None):
+        self.model = model
+        self.plan = kv_cache.build_plan(model)
+        self.max_len = int(max_len or self.plan.max_position)
+        if self.max_len > self.plan.max_position:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's positional "
+                f"table ({self.plan.max_position})")
+        self.max_batch = int(max_batch)
+        self.temperature = float(temperature)
+        self.clock = clock or time.monotonic
+        self._rng = np.random.default_rng(seed)
+        self.strategy = model.strategy or get_strategy()
+
+        variables = model.variables
+        params = (variables["params"] if variables is not None
+                  else model.init(seed)["params"])
+        # Same mesh placement training uses; on the default single-device
+        # strategy this is a no-op device_put.
+        self.params = self.strategy.replicate(params)
+        self.cache = self.strategy.replicate(kv_cache.init_cache(
+            self.plan, max_batch=self.max_batch, max_len=self.max_len,
+            dtype=cache_dtype))
+        logger.info(
+            "serve: %d slots x %d positions, KV cache %.1f MiB, "
+            "buckets %s", self.max_batch, self.max_len,
+            kv_cache.cache_nbytes(self.plan, max_batch=self.max_batch,
+                                  max_len=self.max_len,
+                                  dtype=cache_dtype) / 2**20,
+            buckets or "pow2")
+
+        self.scheduler = Scheduler(self.max_batch, buckets=buckets,
+                                   policy=policy)
+        # Host mirrors of per-slot decode state (compacted with the
+        # scheduler's slot moves).
+        self._tokens = np.zeros(self.max_batch, np.int32)
+        self._lengths = np.zeros(self.max_batch, np.int32)
+        self.finished: list[Request] = []
+
+        # CPU XLA has no buffer donation — donating there only logs
+        # warnings; on TPU the cache updates in place (no per-step copy).
+        donate = (1,) if jax.default_backend() != "cpu" else ()
+        self._decode_fns: dict[int, callable] = {}
+        self._prefill_fns: dict[int, callable] = {}
+        self._donate = donate
+        self._swap_fn = jax.jit(kv_cache.swap_slots,
+                                donate_argnums=(0,) if donate else ())
+
+    @classmethod
+    def from_saved(cls, directory, **kwargs) -> "ServeEngine":
+        """Load a ``save_model`` directory (weights restored, no training
+        compile) and serve it."""
+        from tpu_dist.models import serialize
+
+        model = serialize.load_model(directory, compile=False)
+        return cls(model, **kwargs)
+
+    # -- compiled-program cache ----------------------------------------------
+
+    def _decode_fn(self, bucket: int):
+        fn = self._decode_fns.get(bucket)
+        if fn is None:
+            fn = jax.jit(functools.partial(kv_cache.decode_step, self.plan,
+                                           bucket=bucket),
+                         donate_argnums=self._donate)
+            self._decode_fns[bucket] = fn
+        return fn
+
+    def _prefill_fn(self, pad_len: int):
+        fn = self._prefill_fns.get(pad_len)
+        if fn is None:
+            fn = jax.jit(functools.partial(kv_cache.prefill, self.plan),
+                         donate_argnums=self._donate)
+            self._prefill_fns[pad_len] = fn
+        return fn
+
+    def compiled_programs(self) -> dict:
+        """{'decode': [buckets...], 'prefill': [pad_lens...]} — tests pin
+        the no-retrace property on this."""
+        return {"decode": sorted(self._decode_fns),
+                "prefill": sorted(self._prefill_fns)}
+
+    # -- request intake -------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *, max_new_tokens: int = 32,
+               eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        prompt = [int(t) for t in prompt]
+        if len(prompt) > self.max_len - 1:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens does not fit a "
+                f"{self.max_len}-position cache slot (need >= 1 free)")
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      eos_id=eos_id, deadline_s=deadline_s)
+        self.scheduler.submit(req, now=self.clock())
+        metrics.inc("serve.requests.submitted")
+        return req
+
+    # -- sampling (host-side) -------------------------------------------------
+
+    def _pick(self, logits: np.ndarray) -> int:
+        if self.temperature <= 0.0:
+            return int(np.argmax(logits))
+        z = logits.astype(np.float64) / self.temperature
+        z -= z.max()
+        p = np.exp(z)
+        return int(self._rng.choice(logits.shape[-1], p=p / p.sum()))
+
+    # -- the serving loop -----------------------------------------------------
+
+    def _apply_swap(self, swap: Optional[tuple[int, int]]) -> None:
+        if swap is None:
+            return
+        i, j = swap
+        self.cache = self._swap_fn(self.cache, jnp.int32(i), jnp.int32(j))
+        self._tokens[[i, j]] = self._tokens[[j, i]]
+        self._lengths[[i, j]] = self._lengths[[j, i]]
+
+    def _retire(self, req: Request, *, now: float, status: str) -> None:
+        swap = self.scheduler.finish(req, now=now, status=status)
+        self._apply_swap(swap)
+        self.finished.append(req)
+        if status == DONE:
+            metrics.inc("serve.requests.completed")
+            if req.latency_s is not None:
+                metrics.observe_value("serve.request.latency_s",
+                                      req.latency_s)
+            if req.ttft_s is not None:
+                metrics.observe_value("serve.request.ttft_s", req.ttft_s)
+        else:
+            metrics.inc("serve.requests.evicted")
+
+    def _prefill(self, req: Request) -> None:
+        plen = len(req.prompt)
+        pad = _pad_to_pow2(plen, hi=self.max_len)
+        tokens = np.zeros(pad, np.int32)
+        tokens[:plen] = req.prompt
+        fn = self._prefill_fn(pad)
+        self.cache, logits = fn(self.params, self.cache,
+                                jnp.asarray(tokens), jnp.int32(plen),
+                                jnp.int32(req.slot))
+        metrics.inc("serve.prefills")
+        now = self.clock()
+        token = self._pick(np.asarray(logits))
+        done = self.scheduler.record_token(req, token, now=now)
+        metrics.inc("serve.tokens.generated")
+        self._tokens[req.slot] = token
+        self._lengths[req.slot] = plen
+        if done or plen >= self.max_len:
+            self._retire(req, now=now, status=DONE)
+
+    def step(self) -> int:
+        """One scheduling round: deadline evictions → admissions (each
+        pays its prefill and emits its first token) → one decode step for
+        the active bucket. Returns the number of still-active requests."""
+        now = self.clock()
+        for req, swap in self.scheduler.evict_deadline(now=now):
+            self._apply_swap(swap)
+            self.finished.append(req)
+            metrics.inc("serve.requests.evicted")
+
+        for req in self.scheduler.admit():
+            self._prefill(req)
+        metrics.set_gauge("serve.queue.depth", self.scheduler.queue_depth())
+
+        n = self.scheduler.num_active
+        if n == 0:
+            return 0
+        bucket = self.scheduler.bucket()
+        metrics.observe_value("serve.batch.occupancy", n / bucket)
+        self.cache, logits = self._decode_fn(bucket)(
+            self.params, self.cache, jnp.asarray(self._tokens),
+            jnp.asarray(self._lengths))
+        metrics.inc("serve.decode.steps")
+        logits = np.asarray(logits)
+        now = self.clock()
+        completed = []
+        for req in self.scheduler.active():
+            token = self._pick(logits[req.slot])
+            self._lengths[req.slot] += 1
+            self._tokens[req.slot] = token
+            done = self.scheduler.record_token(req, token, now=now)
+            metrics.inc("serve.tokens.generated")
+            if done or self._lengths[req.slot] >= self.max_len:
+                completed.append(req)
+        # Highest slot first: each swap moves the (untouched) last slot.
+        for req in sorted(completed, key=lambda r: r.slot, reverse=True):
+            self._retire(req, now=now, status=DONE)
+        return self.scheduler.num_active
+
+    def run_until_idle(self, *, max_steps: int = 100_000) -> list[Request]:
+        """Drive :meth:`step` until queue and batch drain; returns all
+        requests finished so far (done + evicted, completion order)."""
+        steps = 0
+        while not self.scheduler.idle():
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"serve loop still busy after {max_steps} steps "
+                    f"({self.scheduler.num_active} active, "
+                    f"{self.scheduler.queue_depth()} queued)")
+        return self.finished
+
+    def generate(self, prompt: Sequence[int], *, max_new_tokens: int = 32,
+                 eos_id: Optional[int] = None) -> list[int]:
+        """Single-request convenience: submit, drain, return the tokens."""
+        req = self.submit(prompt, max_new_tokens=max_new_tokens,
+                          eos_id=eos_id)
+        self.run_until_idle()
+        return req.generated

@@ -841,12 +841,20 @@ class Trainer:
             batches = [b[0] if isinstance(b, tuple) else b for b in x]
         v = self.variables
         n_dev = len(self.strategy.mesh.local_devices)
+        # One compiled program for the whole pass: every batch pads up to
+        # the largest batch size rounded to a device multiple (a ragged
+        # final batch or mixed sizes would otherwise retrace per distinct
+        # length — the no-retrace discipline tpu_dist.serve buckets by).
+        sizes = [int(np.asarray(b).shape[0]) for b in batches]
+        if not sizes:
+            return np.concatenate([], axis=0)
+        target = max(sizes)
+        target += (-target) % n_dev
         outs = []
         for xb in batches:
             xb = np.asarray(xb)
-            # Pad to a device multiple for even sharding, trim after.
             n = xb.shape[0]
-            pad = (-n) % n_dev
+            pad = target - n
             if pad:
                 xb = np.concatenate([xb, np.repeat(xb[-1:], pad, axis=0)])
             placed = self.strategy.distribute_batch(xb)
