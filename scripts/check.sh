@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # Repo gate: shardcheck static analysis, the resilience smoke chaos run,
-# the observe telemetry smoke/bench, the checkpoint stall bench, the
-# serve load bench, then the tier-1 test suite.
+# the elastic preempt+reshape chaos run, the observe telemetry smoke/bench,
+# the checkpoint stall bench, the serve load bench, then the tier-1 test
+# suite.
 #
 # Usage: scripts/check.sh
 #
@@ -45,6 +46,24 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
   || { echo "check.sh: resilience smoke chaos run failed (see $smoke_dir)" >&2
        exit 1; }
 rm -rf "$smoke_dir"
+
+echo "== elastic-smoke: preempt, drain, reshape-on-restore chaos run =="
+# The elastic acceptance demo from README.md "Elastic training": SIGTERM
+# the demo worker at global step 5, which must drain at the next step
+# boundary (bounded by TPU_DIST_PREEMPT_DEADLINE_S), publish its
+# checkpoint, and exit EXIT_PREEMPTED (19); the Supervisor then relaunches
+# the gang on HALF the devices (8 -> 4) and the restore stitches/re-shards
+# the sharded checkpoint onto the new mesh. Gates inside the CLI: a
+# preempt plan without a graceful drain fails, --reshape without a
+# reshape_restore event fails, and the reshaped resume must reach EXACT
+# loss parity with the uninterrupted baseline.
+elastic_dir=$(mktemp -d /tmp/tpu-dist-elastic.XXXXXX)
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
+  --plan preempt@step5 --reshape 8,4 --backoff 0.1 \
+  --workdir "$elastic_dir" >/dev/null \
+  || { echo "check.sh: elastic smoke chaos run failed (see $elastic_dir)" >&2
+       exit 1; }
+rm -rf "$elastic_dir"
 
 echo "== observe-smoke: telemetry overhead bench + series validation =="
 # Off/on/off runs of the demo workload on one compiled step; writes

@@ -1,0 +1,533 @@
+"""Elastic training tests: graceful preemption (SIGTERM → bounded drain →
+checkpoint → EXIT_PREEMPTED), reshape-on-restore (a checkpoint written on P
+processes / D devices restored onto a different gang shape), and
+epoch-boundary rejoin (file rendezvous + liveness forgiveness window +
+per-rank Supervisor relaunch).
+
+Device-count changes can't happen inside one process (the count is baked
+into XLA at backend init), so every cross-shape scenario re-executes under
+``tests/multidevice_harness.py``; the preemption/grace/rejoin machinery is
+exercised both in-process (the drain callback against a monkeypatched seam)
+and across real subprocess gangs (Supervisor grace escalation and per-rank
+rejoin, with plain-Python workers so the gang tests stay fast).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from multidevice_harness import run_with_devices
+from tpu_dist.cluster import bootstrap
+from tpu_dist.cluster.liveness import LivenessMonitor
+from tpu_dist.resilience import FaultPlan, read_events
+from tpu_dist.resilience import entrypoints
+from tpu_dist.resilience.events import EVENT_LOG_ENV, EventLog
+from tpu_dist.resilience.faults import EXIT_FAULT_KILL, EXIT_PREEMPTED
+from tpu_dist.resilience.injector import (PreemptionDrain,
+                                          maybe_preemption_drain)
+from tpu_dist.resilience.supervisor import (AttemptOutcome, GracePolicy,
+                                            Supervisor, classify_exit)
+from tpu_dist.training import checkpoint
+from tpu_dist.training.callbacks import Callback, StopTraining
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+class TestPreemptionSeam:
+    """The SIGTERM→drain plumbing, without sending a real SIGTERM (which
+    would hit the pytest process): the module-level seam is driven
+    directly and the drain callback observed at step boundaries."""
+
+    def test_preempt_fault_kind_parses_with_aliases(self):
+        for plan_text in ("preempt@step5", "sigterm@step5",
+                          "preempt-worker@step5", "preempt_worker@step5"):
+            (f,) = FaultPlan.parse(plan_text).faults
+            assert (f.kind, f.step) == ("preempt", 5), plan_text
+
+    def test_classify_exit_distinguishes_preempted(self):
+        assert classify_exit(EXIT_PREEMPTED) == "preempted"
+        assert classify_exit(EXIT_FAULT_KILL) == "fault_kill"
+        assert classify_exit(0) == "clean"
+        assert classify_exit(-9) == "signal_9"
+
+    def test_attempt_outcome_preempted_property(self):
+        base = dict(attempt=0, duration_s=1.0)
+        assert AttemptOutcome(exit_codes=[EXIT_PREEMPTED, 0], **base).preempted
+        assert not AttemptOutcome(exit_codes=[0], **base).preempted
+        assert not AttemptOutcome(
+            exit_codes=[EXIT_PREEMPTED, EXIT_FAULT_KILL], **base).preempted
+
+    def test_drain_callback_absent_until_armed(self, monkeypatch):
+        monkeypatch.setattr(entrypoints, "_PREEMPT_ARMED", False)
+        assert maybe_preemption_drain() is None
+        monkeypatch.setattr(entrypoints, "_PREEMPT_ARMED", True)
+        assert isinstance(maybe_preemption_drain(), PreemptionDrain)
+
+    def test_drain_stops_only_after_request(self, monkeypatch):
+        monkeypatch.setattr(entrypoints, "_PREEMPT_ARMED", True)
+        monkeypatch.setattr(entrypoints, "_PREEMPT_REQUESTED_AT", None)
+        drain = maybe_preemption_drain()
+        drain.on_batch_end(0, {})  # no request yet: training continues
+        drain.on_epoch_begin(1)
+        monkeypatch.setattr(entrypoints, "_PREEMPT_REQUESTED_AT",
+                            time.monotonic())
+        with pytest.raises(StopTraining, match="preempted"):
+            drain.on_batch_end(1, {})
+        with pytest.raises(StopTraining, match="preempted"):
+            drain.on_epoch_begin(2)
+
+    def test_in_process_drain_stops_fit_at_step_boundary(
+            self, eight_devices, tmp_path, monkeypatch):
+        """Arm the seam, request preemption mid-epoch-1 from a user
+        callback, and verify fit stops at that step boundary with epoch
+        0's checkpoint published — the drain contract the subprocess
+        chaos run relies on, observable in-process."""
+        monkeypatch.setattr(entrypoints, "_PREEMPT_ARMED", True)
+        monkeypatch.setattr(entrypoints, "_PREEMPT_REQUESTED_AT", None)
+
+        class Requester(Callback):
+            wants_batches = True
+
+            def __init__(self):
+                self.batches = 0
+
+            def on_batch_end(self, step, logs):
+                self.batches += 1
+                if self.batches == 3:  # first step of epoch 1
+                    entrypoints._PREEMPT_REQUESTED_AT = time.monotonic()
+
+        model = td.models.build_and_compile_cnn_model(learning_rate=0.01)
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, size=(32,)).astype(np.int32)
+        ds = td.data.Dataset.from_tensor_slices((x, y)).batch(16)
+        hist = model.fit(ds, epochs=3, steps_per_epoch=2, verbose=0,
+                         checkpoint_dir=str(tmp_path),
+                         callbacks=[Requester()])
+        # Drained inside epoch 1: epoch 0 is the only completed epoch and
+        # the only published checkpoint — never a torn mid-epoch state.
+        assert len(hist.history["loss"]) == 1
+        assert checkpoint.latest_complete_step(tmp_path) == 0
+        assert entrypoints.preemption_requested()
+
+
+def _gang_script(body: str) -> list:
+    """argv for a plain-Python (no-jax) Supervisor worker; ``body`` sees
+    ``rank`` parsed from TF_CONFIG."""
+    prelude = textwrap.dedent("""\
+        import json, os, signal, sys, time
+
+        rank = json.loads(os.environ["TF_CONFIG"])["task"]["index"]
+    """)
+    return [sys.executable, "-c", prelude + textwrap.dedent(body)]
+
+
+class TestSupervisorGrace:
+    def test_sigterm_then_drain_exit_classified_preempted(self, tmp_path):
+        """One rank faults; the grace policy SIGTERMs the survivor, which
+        drains to EXIT_PREEMPTED — the report must tell the two kinds of
+        death apart."""
+        cmd = _gang_script(f"""
+            if rank == 1:
+                time.sleep(0.2)
+                sys.exit({EXIT_FAULT_KILL})
+            signal.signal(signal.SIGTERM,
+                          lambda *a: sys.exit({EXIT_PREEMPTED}))
+            time.sleep(30)
+            sys.exit(0)
+        """)
+        sup = Supervisor(
+            cmd, num_workers=2, max_restarts=0,
+            grace=GracePolicy(exit_grace_s=0.3, term_grace_s=5.0),
+            log_dir=tmp_path / "logs",
+            event_log=EventLog(tmp_path / "events.jsonl",
+                               role="supervisor"))
+        report = sup.run()
+        assert not report.success
+        assert sorted(report.outcomes[0].exit_codes) == [
+            EXIT_PREEMPTED, EXIT_FAULT_KILL]
+        kinds = report.to_json()["exit_kinds"][0]
+        assert set(kinds) == {"preempted", "fault_kill"}
+        assert read_events(tmp_path / "events.jsonl", "gang_sigterm")
+
+    def test_grace_escalates_to_sigkill(self, tmp_path):
+        """A worker that ignores SIGTERM is SIGKILLed after term_grace_s —
+        the gang never wedges on a stuck drain."""
+        cmd = _gang_script(f"""
+            if rank == 1:
+                time.sleep(0.2)
+                sys.exit({EXIT_FAULT_KILL})
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            time.sleep(60)
+        """)
+        sup = Supervisor(
+            cmd, num_workers=2, max_restarts=0,
+            grace=GracePolicy(exit_grace_s=0.2, term_grace_s=0.5),
+            log_dir=tmp_path / "logs",
+            event_log=EventLog(tmp_path / "events.jsonl",
+                               role="supervisor"))
+        report = sup.run()
+        assert not report.success
+        codes = report.outcomes[0].exit_codes
+        assert -9 in codes, codes  # SIGKILL
+        assert "signal_9" in report.to_json()["exit_kinds"][0]
+        assert read_events(tmp_path / "events.jsonl", "gang_sigkill")
+
+
+class TestSupervisorRejoin:
+    def test_crashed_rank_rejoins_without_gang_restart(self, tmp_path):
+        """With a rejoin window armed, a non-chief crash is absorbed by a
+        per-rank relaunch inside the SAME attempt — zero gang restarts."""
+        marker = tmp_path / "crashed-once"
+        cmd = _gang_script(f"""
+            if rank == 1:
+                m = {str(marker)!r}
+                if not os.path.exists(m):
+                    open(m, "w").close()
+                    sys.exit(7)  # first life: crash
+                sys.exit(0)      # relaunched life: clean
+            time.sleep(4)
+            sys.exit(0)
+        """)
+        sup = Supervisor(
+            cmd, num_workers=2, max_restarts=0,
+            rejoin_window_s=30.0, max_rejoins=2,
+            log_dir=tmp_path / "logs",
+            event_log=EventLog(tmp_path / "events.jsonl",
+                               role="supervisor"))
+        report = sup.run()
+        assert report.success
+        assert report.attempts == 1 and report.restarts == 0
+        assert report.outcomes[0].rejoins == 1
+        (ev,) = read_events(tmp_path / "events.jsonl", "worker_rejoin")
+        assert ev["rank"] == 1
+
+    def test_rank0_crash_still_restarts_the_gang(self, tmp_path):
+        """Rank 0 hosts the coordination service: its death can never be
+        absorbed by a per-rank relaunch."""
+        cmd = _gang_script("""
+            if rank == 0:
+                time.sleep(0.2)
+                sys.exit(7)
+            time.sleep(4)
+            sys.exit(0)
+        """)
+        sup = Supervisor(cmd, num_workers=2, max_restarts=0,
+                         rejoin_window_s=30.0, log_dir=tmp_path / "logs")
+        report = sup.run()
+        assert not report.success
+        assert report.outcomes[0].rejoins == 0
+
+
+class TestEpochRendezvous:
+    def test_single_rank_is_immediate(self, tmp_path):
+        assert bootstrap.epoch_rendezvous(
+            tmp_path, epoch=0, rank=0, world=1) == [0]
+
+    def test_two_ranks_meet_across_threads(self, tmp_path):
+        results = {}
+
+        def late_rank():
+            time.sleep(0.2)
+            results[1] = bootstrap.epoch_rendezvous(
+                tmp_path, epoch=3, rank=1, world=2, timeout_s=10)
+
+        t = threading.Thread(target=late_rank)
+        t.start()
+        results[0] = bootstrap.epoch_rendezvous(
+            tmp_path, epoch=3, rank=0, world=2, timeout_s=10)
+        t.join()
+        assert results[0] == results[1] == [0, 1]
+
+    def test_timeout_names_the_missing_rank(self, tmp_path):
+        with pytest.raises(TimeoutError, match=r"missing rank\(s\) \[1\]"):
+            bootstrap.epoch_rendezvous(
+                tmp_path, epoch=0, rank=0, world=2, timeout_s=0.3)
+
+    def test_old_epoch_markers_are_garbage_collected(self, tmp_path):
+        for epoch in range(3):
+            bootstrap.epoch_rendezvous(tmp_path, epoch=epoch, rank=0,
+                                       world=1)
+        names = sorted(p.name for p in tmp_path.glob("epoch-*"))
+        # Epoch 0 markers (< current-1) are gone; 1 and 2 remain (the
+        # previous epoch stays so a slow peer can still observe it).
+        assert names == ["epoch-1.rank-0", "epoch-2.rank-0"]
+
+
+class TestLivenessRejoinWindow:
+    def test_zero_window_fails_immediately(self):
+        m = LivenessMonitor(rejoin_window_s=0.0)
+        assert m._observe([1], now=0.0)
+        assert m.failed and m.dead_peers == [1]
+
+    def test_suspect_recovers_within_window(self):
+        m = LivenessMonitor(rejoin_window_s=5.0)
+        assert not m._observe([2], now=0.0)
+        assert m.suspect_peers == [2] and not m.failed
+        assert not m._observe([], now=1.0)  # peer answers again
+        assert m.suspect_peers == [] and not m.failed
+
+    def test_suspect_expires_into_failure(self):
+        m = LivenessMonitor(rejoin_window_s=5.0)
+        assert not m._observe([2], now=0.0)
+        assert not m._observe([2], now=4.0)  # still inside the window
+        assert m._observe([2], now=6.0)
+        assert m.failed and m.dead_peers == [2]
+
+
+def _demo_body(ckdir, epochs: int) -> str:
+    """Harness body: run the chaos-demo workload itself (the workload whose
+    cross-device-count loss parity the CLI chaos gate certifies) with
+    sharded per-epoch checkpoints; emits its losses. Resumes from ``ckdir``
+    when a prior run left checkpoints there — on a different device count,
+    that is a reshape-on-restore."""
+    return textwrap.dedent(f"""
+        from tpu_dist.resilience import entrypoints
+
+        os.environ[entrypoints.CHECKPOINT_DIR_ENV] = {str(ckdir)!r}
+        os.environ["TPU_DIST_DEMO_STRATEGY"] = "mirrored"
+        os.environ["TPU_DIST_DEMO_SHARDED"] = "1"
+        os.environ["TPU_DIST_DEMO_EPOCHS"] = "{epochs}"
+        emit(entrypoints.demo_train())
+        """)
+
+
+@pytest.fixture(scope="module")
+def demo_baseline(tmp_path_factory):
+    """Uninterrupted 3-epoch demo losses on 8 devices — the parity anchor.
+    The demo's global batch is fixed, so every device count reproduces
+    these losses bit-for-bit (the property the reshape tests assert)."""
+    ck = tmp_path_factory.mktemp("elastic-baseline") / "ckpt"
+    return run_with_devices(_demo_body(ck, 3), 8)["losses"]
+
+
+class TestReshapeOnRestore:
+    """Real multi-device reshapes via the in-process 8-device harness:
+    save on P devices, restore on Q≠P, demand EXACT loss parity with the
+    uninterrupted baseline."""
+
+    def _run_reshape(self, tmp_path, demo_baseline, save_on: int,
+                     resume_on: int):
+        ck = tmp_path / "ckpt"
+        events_path = tmp_path / "events.jsonl"
+        part = run_with_devices(_demo_body(ck, 2), save_on)
+        assert part["losses"] == demo_baseline[:2]
+        res = run_with_devices(
+            _demo_body(ck, 3), resume_on,
+            extra_env={EVENT_LOG_ENV: str(events_path)})
+        # Resumed epoch 2 on the NEW device count matches the baseline
+        # bit-for-bit — exact parity, not allclose.
+        assert res["losses"] == [demo_baseline[2]]
+        (ev,) = read_events(events_path, "reshape_restore")
+        assert ev["saved_device_count"] == save_on
+        assert ev["device_count"] == resume_on
+        return ev
+
+    def test_reshape_8_to_4_exact_parity(self, tmp_path, demo_baseline):
+        self._run_reshape(tmp_path, demo_baseline, save_on=8, resume_on=4)
+
+    def test_reshape_4_to_8_exact_parity(self, tmp_path, demo_baseline):
+        self._run_reshape(tmp_path, demo_baseline, save_on=4, resume_on=8)
+
+
+_TP_PRELUDE = textwrap.dedent("""
+    import numpy as np
+
+    import tpu_dist as td
+    from tpu_dist.models.transformer import build_transformer_lm
+    from tpu_dist.ops import Adam, SparseCategoricalCrossentropy
+    from tpu_dist.parallel.strategy import MirroredStrategy
+    from tpu_dist.training import checkpoint
+
+
+    def tp_scope(axes):
+        return MirroredStrategy(axis_shapes=axes).scope()
+
+
+    def tp_model():
+        model = build_transformer_lm(61, 8, d_model=32, depth=2,
+                                     num_heads=4)
+        model.compile(loss=SparseCategoricalCrossentropy(from_logits=True),
+                      optimizer=Adam(1e-2))
+        return model
+
+
+    def flat_state(model):
+        v = model.variables
+        return ({k: np.asarray(a)
+                 for k, a in checkpoint._flatten(v["params"]).items()},
+                {k: np.asarray(a)
+                 for k, a in checkpoint._flatten(v["opt"]).items()})
+""")
+
+
+class TestReshapeRoundTrip:
+    def test_tp_p_to_q_to_p_is_bit_identical(self, tmp_path):
+        """A TP (model-axis sharded) checkpoint taken on 8 devices,
+        restored+resaved on 4, restored again on 8 must hand back
+        bit-identical params and allclose optimizer moments — stitching
+        and re-sharding are lossless, not merely approximate."""
+        ck1, ck2 = tmp_path / "ck-8dev", tmp_path / "ck-4dev"
+        body_a = _TP_PRELUDE + textwrap.dedent(f"""
+            with tp_scope({{"data": 2, "model": 4}}):
+                model = tp_model()
+                rng = np.random.default_rng(0)
+                xs = rng.integers(0, 61, (32, 8)).astype(np.int64)
+                ds = td.data.Dataset.from_tensor_slices(
+                    (xs, np.roll(xs, -1, 1))).batch(16)
+                model.fit(ds, epochs=1, verbose=0)
+                checkpoint.save({str(ck1)!r}, model, step=1, sharded=True)
+            emit({{"saved": True}})
+        """)
+        # 4 devices, data axis collapsed, model axis kept: every sharded
+        # leaf re-places exactly (model=4 divides as before).
+        body_b = _TP_PRELUDE + textwrap.dedent(f"""
+            with tp_scope({{"data": 1, "model": 4}}):
+                model = tp_model()
+                step = checkpoint.restore_model({str(ck1)!r}, model)
+                checkpoint.save({str(ck2)!r}, model, step=step,
+                                sharded=True)
+            emit({{"restored_step": step}})
+        """)
+        body_c = _TP_PRELUDE + textwrap.dedent(f"""
+            with tp_scope({{"data": 2, "model": 4}}):
+                model = tp_model()
+                checkpoint.restore_model({str(ck1)!r}, model)
+                p1, o1 = flat_state(model)
+                checkpoint.restore_model({str(ck2)!r}, model)
+                p2, o2 = flat_state(model)
+            emit({{
+                "params_equal": all(np.array_equal(p1[k], p2[k])
+                                    for k in p1),
+                "opt_allclose": all(np.allclose(o1[k], o2[k],
+                                                rtol=1e-7, atol=1e-8)
+                                    for k in o1),
+                "n_params": len(p1), "n_opt": len(o1),
+            }})
+        """)
+        assert run_with_devices(body_a, 8)["saved"]
+        assert run_with_devices(body_b, 4)["restored_step"] == 1
+        verdict = run_with_devices(body_c, 8)
+        assert verdict["n_params"] > 0 and verdict["n_opt"] > 0
+        assert verdict["params_equal"], verdict
+        assert verdict["opt_allclose"], verdict
+
+
+class TestRestoreFailureModes:
+    """Every broken-layout restore must refuse LOUDLY — a torn or
+    mis-shaped elastic restore silently producing wrong state is the worst
+    failure this subsystem can have."""
+
+    def _save_tp(self, tmp_path, d_model=32):
+        from tpu_dist.models.transformer import build_transformer_lm
+        from tpu_dist.ops import Adam, SparseCategoricalCrossentropy
+
+        strategy = td.MirroredStrategy(axis_shapes={"data": 2, "model": 4})
+        with strategy.scope():
+            model = build_transformer_lm(61, 8, d_model=d_model, depth=2,
+                                         num_heads=4)
+            model.compile(
+                loss=SparseCategoricalCrossentropy(from_logits=True),
+                optimizer=Adam(1e-2))
+            rng = np.random.default_rng(0)
+            xs = rng.integers(0, 61, (32, 8)).astype(np.int64)
+            ds = td.data.Dataset.from_tensor_slices(
+                (xs, np.roll(xs, -1, 1))).batch(16)
+            model.fit(ds, epochs=1, verbose=0)
+            path = checkpoint.save(tmp_path, model, step=1, sharded=True)
+        return pathlib.Path(path), model
+
+    def _template(self, model):
+        return {k: model.variables[k] for k in ("params", "state", "opt")
+                if k in model.variables}
+
+    def test_missing_shard_arrays_file(self, tmp_path, eight_devices):
+        path, model = self._save_tp(tmp_path)
+        os.remove(path / "arrays-shard-0.npz")
+        with pytest.raises(ValueError, match="failed validation"):
+            checkpoint.restore(tmp_path, self._template(model), step=1)
+
+    def test_shard_index_shape_mismatch(self, tmp_path, eight_devices):
+        path, model = self._save_tp(tmp_path)
+        idx = path / "shards-0.json"
+        listing = json.loads(idx.read_text())
+        # Shrink the first sharded entry's slice: the index now claims a
+        # different extent than the stored array.
+        for entries in listing.values():
+            a, b = entries[0]["slices"][0]
+            if b - a > 1:
+                entries[0]["slices"][0] = [a, b - 1]
+                break
+        idx.write_text(json.dumps(listing))
+        with pytest.raises(ValueError,
+                           match="shard index and data disagree"):
+            checkpoint.restore(tmp_path, self._template(model), step=1)
+
+    def test_reshape_onto_non_divisor_axis_raises(self, tmp_path,
+                                                  eight_devices):
+        """d_model=36 shards cleanly on model=4 but NOT on model=8: the
+        restore must refuse rather than silently replicate what the saving
+        job kept sharded."""
+        from tpu_dist.models.transformer import build_transformer_lm
+        from tpu_dist.ops import Adam, SparseCategoricalCrossentropy
+
+        self._save_tp(tmp_path, d_model=36)
+        s2 = td.MirroredStrategy(axis_shapes={"data": 1, "model": 8})
+        with s2.scope():
+            m2 = build_transformer_lm(61, 8, d_model=36, depth=2,
+                                      num_heads=4)
+            m2.compile(
+                loss=SparseCategoricalCrossentropy(from_logits=True),
+                optimizer=Adam(1e-2))
+            with pytest.raises(ValueError,
+                               match="does not divide mesh axis"):
+                checkpoint.restore_model(tmp_path, m2, step=1)
+
+
+class TestElasticChaosCli:
+    def test_preempt_and_reshape_end_to_end(self, tmp_path):
+        """The tentpole acceptance demo (scripts/check.sh elastic-smoke):
+        SIGTERM at step 5 → bounded drain → checkpoint published →
+        EXIT_PREEMPTED → gang relaunched on HALF the devices →
+        reshape-on-restore → exact loss parity with the uninterrupted
+        baseline. The CLI itself rejects vacuous runs (no drain event, or
+        no reshape_restore event → ok=false)."""
+        report_path = tmp_path / "report.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.resilience",
+             "--plan", "preempt@step5",
+             "--reshape", "8,4",
+             "--backoff", "0.1",
+             "--workdir", str(tmp_path / "chaos"),
+             "--report", str(report_path)],
+            capture_output=True, text=True, timeout=420,
+            cwd=str(REPO_ROOT), env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(report_path.read_text())
+        assert report["ok"] and report["success"]
+        assert report["exit_kinds"][0] == ["preempted"]
+        assert report["exit_kinds"][-1] == ["clean"]
+        assert report["gang_shapes"][0]["device_count"] == 8
+        assert report["gang_shapes"][-1]["device_count"] == 4
+        assert report["drain_s"][0] is not None
+        assert report["drain_s"][0] <= 60.0
+        (resh,) = report["reshape_restores"]
+        assert resh["saved_device_count"] == 8
+        assert resh["device_count"] == 4
+        assert report["parity_ok"]
+        assert report["loss_delta"] == 0.0  # exact, not approximate
+        kinds = [e["event"] for e in read_events(
+            tmp_path / "chaos" / "events.jsonl")]
+        assert "preempt_requested" in kinds
+        assert "preempt_drained" in kinds
+        assert "reshape_restore" in kinds

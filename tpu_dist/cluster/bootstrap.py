@@ -215,3 +215,71 @@ def barrier(name: str = "tpu_dist_barrier") -> None:
     # Barrier wait time is the cluster's skew made visible — the telemetry
     # hook records it like any other host collective (tpu_dist.observe).
     fire_observe_hook("barrier", seconds=time.perf_counter() - t0)
+
+
+#: Environment variable naming the shared directory used for the elastic
+#: epoch-boundary rendezvous. Setting it arms ``RejoinGate`` in every fit().
+REJOIN_DIR_ENV = "TPU_DIST_REJOIN_DIR"
+
+
+def epoch_rendezvous(directory, *, epoch: int, rank: Optional[int] = None,
+                     world: Optional[int] = None, timeout_s: float = 120.0,
+                     poll_s: float = 0.05) -> "list[int]":
+    """Shared-filesystem epoch-boundary barrier for elastic rejoin.
+
+    Each worker atomically publishes a ``epoch-{E}.rank-{r}`` marker under
+    ``directory`` and polls until markers from all ``world`` ranks for that
+    epoch exist, then returns the sorted rank list. This is deliberately NOT
+    ``sync_global_devices``: a worker relaunched after a preemption is a new
+    process outside the surviving gang's collective clique, and the meeting
+    protocol that lets it back in cannot itself require membership. A shared
+    directory (the same assumption the v2 sharded checkpoint already makes)
+    is the lowest-common-denominator rendezvous medium.
+
+    Raises :class:`TimeoutError` naming the missing ranks if the gang does
+    not fully assemble within ``timeout_s`` — the caller (usually
+    ``RejoinGate``) surfaces that as a liveness failure rather than stepping
+    with a partial gang.
+    """
+    import pathlib
+    import time
+
+    if rank is None:
+        rank = process_index()
+    if world is None:
+        world = process_count()
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    marker = d / f"epoch-{epoch}.rank-{rank}"
+    tmp = d / f".epoch-{epoch}.rank-{rank}.{os.getpid()}.tmp"
+    tmp.write_text(str(os.getpid()), encoding="utf-8")
+    os.replace(tmp, marker)  # atomic publish; re-publishing is idempotent
+    # Markers two epochs back can never be waited on again — reap this
+    # rank's own so a long run does not grow the directory unboundedly.
+    for old in d.glob(f"epoch-*.rank-{rank}"):
+        try:
+            e = int(old.name.split(".", 1)[0].split("-", 1)[1])
+        except ValueError:
+            continue
+        if e < epoch - 1:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        present = set()
+        for p in d.glob(f"epoch-{epoch}.rank-*"):
+            suffix = p.name.rsplit("rank-", 1)[1]
+            if suffix.isdigit():
+                present.add(int(suffix))
+        if len(present & set(range(world))) >= world:
+            return sorted(present & set(range(world)))
+        if time.monotonic() > deadline:
+            missing = sorted(set(range(world)) - present)
+            raise TimeoutError(
+                f"epoch_rendezvous: epoch {epoch} barrier in {d} timed out "
+                f"after {timeout_s:.1f}s; missing rank(s) {missing} "
+                f"(present: {sorted(present)})")
+        time.sleep(poll_s)

@@ -205,16 +205,31 @@ def check_peer_health(timeout_s: float = DEFAULT_TIMEOUT_S,
 
 
 class LivenessMonitor:
-    """Background peer-health thread — the D12 health-check analog."""
+    """Background peer-health thread — the D12 health-check analog.
+
+    Elastic extension: with ``rejoin_window_s > 0`` a dead peer is first
+    marked SUSPECT instead of immediately condemning the job. The monitor
+    keeps probing; if the peer answers again within the window (the
+    supervisor relaunched it and it re-entered at the epoch-boundary
+    rendezvous), the suspicion clears and training was never interrupted.
+    Only when the window expires with the peer still dead does the monitor
+    fail terminally — the reference's fail-fast semantics, just with a
+    bounded forgiveness period. ``rejoin_window_s = 0`` (the default) keeps
+    the original first-death-is-terminal behavior.
+    """
 
     def __init__(self, interval_s: float = DEFAULT_INTERVAL_S,
-                 timeout_s: float = DEFAULT_TIMEOUT_S):
+                 timeout_s: float = DEFAULT_TIMEOUT_S,
+                 rejoin_window_s: float = 0.0):
         self.interval_s = interval_s
         self.timeout_s = timeout_s
+        self.rejoin_window_s = float(rejoin_window_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._dead_peers: Sequence[int] = []
         self._failed = threading.Event()
+        #: peer id -> monotonic deadline by which it must answer again.
+        self._suspects: dict = {}
 
     def start(self) -> "LivenessMonitor":
         import jax
@@ -259,14 +274,54 @@ class LivenessMonitor:
 
                 dead = [i for i in range(jax.process_count())
                         if i != jax.process_index()]
-            if dead:
-                self._dead_peers = dead
-                self._failed.set()
-                logger.error(
-                    "peer process(es) %s unreachable; collectives will not "
-                    "complete — restart the job (reference semantics: "
-                    "UnavailableError, SURVEY.md §5.3)", dead)
+            if self._observe(dead):
                 return
+
+    def _observe(self, dead: Sequence[int],
+                 now: Optional[float] = None) -> bool:
+        """Fold one probe result into suspect/failed state; True = terminal.
+
+        Split from :meth:`_loop` so the rejoin-window state machine is
+        testable without threads or a real coordination service.
+        """
+        import time
+
+        from tpu_dist.resilience import events
+
+        now = time.monotonic() if now is None else now
+        dead_set = set(dead)
+        if self.rejoin_window_s <= 0 and dead_set:
+            self._dead_peers = sorted(dead_set)
+            self._failed.set()
+            logger.error(
+                "peer process(es) %s unreachable; collectives will not "
+                "complete — restart the job (reference semantics: "
+                "UnavailableError, SURVEY.md §5.3)", sorted(dead_set))
+            return True
+        # Rejoin window armed: newly-dead peers become suspects ...
+        for peer in dead_set - set(self._suspects):
+            self._suspects[peer] = now + self.rejoin_window_s
+            logger.warning(
+                "peer %d unreachable; suspect for %.0fs pending rejoin",
+                peer, self.rejoin_window_s)
+            events.maybe_log("peer_suspect", peer=peer,
+                             rejoin_window_s=self.rejoin_window_s)
+        # ... answering suspects recover ...
+        for peer in sorted(set(self._suspects) - dead_set):
+            del self._suspects[peer]
+            logger.info("peer %d answered again; rejoin complete", peer)
+            events.maybe_log("peer_rejoined", peer=peer)
+        # ... and suspects past their deadline condemn the job.
+        expired = sorted(p for p, t in self._suspects.items() if now > t)
+        if expired:
+            self._dead_peers = expired
+            self._failed.set()
+            logger.error(
+                "peer process(es) %s did not rejoin within %.0fs; "
+                "restart the job", expired, self.rejoin_window_s)
+            events.maybe_log("peer_rejoin_expired", peers=expired)
+            return True
+        return False
 
     @property
     def failed(self) -> bool:
@@ -275,6 +330,11 @@ class LivenessMonitor:
     @property
     def dead_peers(self) -> Sequence[int]:
         return list(self._dead_peers)
+
+    @property
+    def suspect_peers(self) -> Sequence[int]:
+        """Peers currently inside their rejoin window (not yet condemned)."""
+        return sorted(self._suspects)
 
     def raise_if_failed(self) -> None:
         if self.failed:

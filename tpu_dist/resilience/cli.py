@@ -72,10 +72,12 @@ def _clean_env(extra: dict) -> dict:
     return env
 
 
-def run_baseline(workdir: pathlib.Path, *, timeout: float) -> Optional[dict]:
+def run_baseline(workdir: pathlib.Path, *, timeout: float,
+                 extra_env: Optional[dict] = None) -> Optional[dict]:
     """One uninterrupted run in a subprocess; returns its RESULT dict."""
     log_path = workdir / "baseline.log"
-    env = _clean_env({CHECKPOINT_DIR_ENV: str(workdir / "baseline-ckpt")})
+    env = _clean_env({CHECKPOINT_DIR_ENV: str(workdir / "baseline-ckpt"),
+                      **(extra_env or {})})
     with open(log_path, "wb") as log:
         code = subprocess.call(_worker_cmd(), env=env, stdout=log,
                                stderr=subprocess.STDOUT, timeout=timeout)
@@ -84,6 +86,20 @@ def run_baseline(workdir: pathlib.Path, *, timeout: float) -> Optional[dict]:
         raise RuntimeError(
             f"baseline run exited {code}; see {log_path}:\n{text[-2000:]}")
     return parse_result_line(text)
+
+
+def _parse_reshape(arg: Optional[str]) -> Optional[list]:
+    if not arg:
+        return None
+    try:
+        counts = [int(tok) for tok in arg.split(",") if tok.strip()]
+    except ValueError:
+        counts = []
+    if len(counts) < 2 or any(n < 1 for n in counts):
+        raise SystemExit(
+            f"error: --reshape wants >= 2 comma-separated positive device "
+            f"counts (e.g. 8,4), got {arg!r}")
+    return counts
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -118,6 +134,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip the baseline run (no parity check)")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="overall per-run timeout for the baseline")
+    p.add_argument("--reshape", default=None, metavar="N,M[,...]",
+                   help="elastic reshape schedule: attempt k runs on the "
+                        "k-th device count (last repeats), e.g. 8,4 = die "
+                        "on 8 devices, restart reshaped onto 4. Arms the "
+                        "demo's multi-device sharded mode and requires a "
+                        "reshape_restore to actually happen (else the run "
+                        "is vacuous and fails). The baseline runs at the "
+                        "first count.")
     return p
 
 
@@ -134,16 +158,34 @@ def main(argv: Optional[list] = None) -> int:
     for line in describe(plan):
         print(f"fault: {line}", file=sys.stderr)
 
+    reshape = _parse_reshape(args.reshape)
+    # Reshape runs flip the demo into explicit multi-device mode: a
+    # MirroredStrategy over every (forced-host-platform) local device plus
+    # a v2 SHARDED checkpoint, so the restart actually exercises
+    # stitch-the-shards + re-shard-onto-Q-devices rather than a replicated
+    # v1 broadcast.
+    demo_env = ({"TPU_DIST_DEMO_STRATEGY": "mirrored",
+                 "TPU_DIST_DEMO_SHARDED": "1"} if reshape else {})
+
     baseline = None
     if not args.no_baseline:
         print("running baseline (no faults)...", file=sys.stderr)
-        baseline = run_baseline(workdir, timeout=args.timeout)
+        baseline_env = dict(demo_env)
+        if reshape:
+            baseline_env.update({
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS":
+                    f"--xla_force_host_platform_device_count={reshape[0]}",
+            })
+        baseline = run_baseline(workdir, timeout=args.timeout,
+                                extra_env=baseline_env)
 
     event_path = workdir / "events.jsonl"
     extra_env = {
         FAULT_PLAN_ENV: plan.dumps(),
         events.EVENT_LOG_ENV: str(event_path),
         CHECKPOINT_DIR_ENV: str(workdir / "ckpt"),
+        **demo_env,
     }
     if args.entry:
         extra_env[ENTRY_ENV] = args.entry
@@ -154,7 +196,8 @@ def main(argv: Optional[list] = None) -> int:
         backoff=BackoffPolicy(initial_s=args.backoff),
         env=_clean_env(extra_env), log_dir=workdir / "logs",
         event_log=events.EventLog(event_path, role="supervisor"),
-        observe_dir=workdir / "observe")
+        observe_dir=workdir / "observe",
+        device_schedule=reshape)
     sup_report = sup.run()
 
     final = None
@@ -163,15 +206,26 @@ def main(argv: Optional[list] = None) -> int:
             sup_report.attempts - 1, 0).read_text(errors="replace"))
 
     fired = events.read_events(event_path, "fault_fired")
+    sup_json = sup_report.to_json()
+    reshape_events = events.read_events(event_path, "reshape_restore")
+    drained = events.read_events(event_path, "preempt_drained")
     report = {
         "plan": plan.to_json(),
         "workdir": str(workdir),
         "success": sup_report.success,
         "attempts": sup_report.attempts,
         "restarts": sup_report.restarts,
-        "recovery_wall_s": sup_report.to_json()["recovery_wall_s"],
-        "wall_time_s": sup_report.to_json()["wall_time_s"],
+        "recovery_wall_s": sup_json["recovery_wall_s"],
+        "wall_time_s": sup_json["wall_time_s"],
         "exit_codes": [o.exit_codes for o in sup_report.outcomes],
+        "exit_kinds": sup_json["exit_kinds"],
+        "gang_shapes": sup_json["gang_shapes"],
+        "drain_s": sup_json["drain_s"],
+        "reshape_restores": [
+            {k: r.get(k) for k in ("step", "saved_device_count",
+                                   "device_count", "saved_process_count",
+                                   "process_count")}
+            for r in reshape_events],
         "faults_fired": [
             {k: r.get(k) for k in ("kind", "at", "step", "op", "mode")
              if r.get(k) is not None} for r in fired],
@@ -206,6 +260,22 @@ def main(argv: Optional[list] = None) -> int:
     ok = sup_report.success and bool(fired)
     if not fired:
         report["failure"] = "no fault fired — vacuous chaos run"
+    # Anti-vacuity gates for the elastic machinery: a preempt plan must
+    # show a real SIGTERM drain (preempted exit + preempt_drained event),
+    # and a --reshape run must show an actual cross-topology restore.
+    if any(f.kind == "preempt" for f in plan.faults):
+        preempted = any("preempted" in kinds
+                        for kinds in sup_json["exit_kinds"])
+        if not (preempted and drained):
+            ok = False
+            report["failure"] = (
+                "preempt plan but no graceful drain observed "
+                f"(preempted_exit={preempted}, drained={bool(drained)})")
+    if reshape:
+        if not reshape_events:
+            ok = False
+            report["failure"] = ("--reshape given but no reshape_restore "
+                                 "happened — vacuous reshape run")
     if baseline is not None:
         report["baseline_final_loss"] = baseline.get("final_loss")
         if (report["final_loss"] is not None

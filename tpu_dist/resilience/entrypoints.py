@@ -20,6 +20,20 @@ trainer derives each epoch's RNG keys from the epoch index alone, and the
 dataset's cardinality equals ``steps_per_epoch``, so epoch N sees identical
 batches whether or not the process was restarted in between).
 
+A fourth property makes a worker ELASTIC: :func:`run_entry` installs a
+SIGTERM seam (:func:`install_sigterm_handler`) before training starts, so a
+preemption notice — from the cloud provider, from the Supervisor's grace
+policy, or from an injected ``preempt`` fault — triggers the graceful drain:
+the :class:`~tpu_dist.resilience.injector.PreemptionDrain` callback stops the
+fit at the next step boundary, ``on_train_end`` publishes any in-flight
+``save_async``, and the worker exits
+:data:`~tpu_dist.resilience.faults.EXIT_PREEMPTED` — all inside a bounded
+deadline (``TPU_DIST_PREEMPT_DEADLINE_S``): a watchdog hard-exits a drain
+that wedges, and the Supervisor's SIGKILL escalation backstops even that.
+Resume stays exactly-reproducible because the drain never publishes torn
+mid-epoch state — the restarted attempt replays the interrupted epoch from
+its last epoch-boundary checkpoint with the same epoch-derived RNG keys.
+
 Configuration comes through the environment so the supervisor can launch
 the same argv for every worker of every attempt:
 
@@ -29,6 +43,13 @@ the same argv for every worker of every attempt:
 ``TPU_DIST_DEMO_EPOCHS``              epochs (default 3)
 ``TPU_DIST_DEMO_STEPS_PER_EPOCH``     steps per epoch (default 4)
 ``TPU_DIST_DEMO_BATCH``               global batch size (default 32)
+``TPU_DIST_DEMO_STRATEGY``            ``mirrored`` = data-parallel over all
+                                      local devices (the elastic/reshape
+                                      demo); default: single-device
+``TPU_DIST_DEMO_SHARDED``             ``1`` = per-epoch checkpoints use the
+                                      v2 sharded layout
+``TPU_DIST_PREEMPT_DEADLINE_S``       graceful-drain watchdog deadline
+                                      (default 60)
 ``TPU_DIST_ENTRY``                    ``module:callable`` to run instead of
                                       :func:`demo_train` (``python -m
                                       tpu_dist.resilience.entrypoints``)
@@ -40,13 +61,94 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
+import time
 from typing import Callable, Optional
 
 from tpu_dist.resilience import events
-from tpu_dist.resilience.faults import EXIT_PEER_UNAVAILABLE
+from tpu_dist.resilience.faults import EXIT_PEER_UNAVAILABLE, EXIT_PREEMPTED
 
 CHECKPOINT_DIR_ENV = "TPU_DIST_CHECKPOINT_DIR"
 ENTRY_ENV = "TPU_DIST_ENTRY"
+PREEMPT_DEADLINE_ENV = "TPU_DIST_PREEMPT_DEADLINE_S"
+
+
+# -- graceful-preemption seam -------------------------------------------------
+# Module-level so the trainer (via injector.maybe_preemption_drain) and the
+# entry-point wrapper observe the same request without passing state through
+# the fit call chain. One process == one preemption lifecycle.
+
+_PREEMPT_LOCK = threading.Lock()
+_PREEMPT_ARMED = False
+_PREEMPT_REQUESTED_AT: Optional[float] = None
+
+
+def preemption_armed() -> bool:
+    """True once :func:`install_sigterm_handler` ran in this process — the
+    trainer arms its drain callback off this, so unsupervised fits never pay
+    the per-step flag check."""
+    return _PREEMPT_ARMED
+
+
+def preemption_requested() -> bool:
+    return _PREEMPT_REQUESTED_AT is not None
+
+
+def preemption_requested_at() -> Optional[float]:
+    """``time.monotonic()`` of the first SIGTERM, or None."""
+    return _PREEMPT_REQUESTED_AT
+
+
+def _drain_deadline_s() -> float:
+    try:
+        return float(os.environ.get(PREEMPT_DEADLINE_ENV, "60"))
+    except ValueError:
+        return 60.0
+
+
+def install_sigterm_handler() -> None:
+    """Arm the graceful-preemption seam (idempotent, main thread only).
+
+    On SIGTERM: record the request (the ``PreemptionDrain`` callback stops
+    training at the next step boundary), count it
+    (``elastic.preemptions``), and start the drain watchdog — a daemon
+    timer that hard-exits the process if the drain outlives its deadline,
+    so a wedged drain (a hung collective inside the final commit) cannot
+    outstall the supervisor's own SIGKILL escalation."""
+    global _PREEMPT_ARMED
+    import signal
+
+    def _on_sigterm(signum, frame):
+        global _PREEMPT_REQUESTED_AT
+        with _PREEMPT_LOCK:
+            if _PREEMPT_REQUESTED_AT is not None:
+                return  # duplicate notice; drain already underway
+            _PREEMPT_REQUESTED_AT = time.monotonic()
+        deadline = _drain_deadline_s()
+        from tpu_dist.observe import metrics as metrics_lib
+
+        metrics_lib.inc("elastic.preemptions")
+        events.maybe_log("preempt_requested", deadline_s=deadline,
+                         attempt=events.current_attempt())
+        print(f"tpu_dist.resilience: SIGTERM received — draining at the "
+              f"next step boundary (deadline {deadline:.0f}s)",
+              file=sys.stderr, flush=True)
+
+        def _watchdog():
+            time.sleep(deadline)
+            # Still alive past the deadline: the drain wedged. Exit hard
+            # with a crash code (NOT EXIT_PREEMPTED — the checkpoint may be
+            # torn, and the supervisor must not classify this as a clean
+            # drain).
+            events.maybe_log("preempt_drain_timeout", deadline_s=deadline,
+                             attempt=events.current_attempt())
+            os._exit(1)
+
+        threading.Thread(target=_watchdog, daemon=True,
+                         name="tpu-dist-preempt-watchdog").start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    _PREEMPT_ARMED = True
 
 
 def _env_int(name: str, default: int) -> int:
@@ -75,6 +177,8 @@ def demo_train() -> dict:
     under ``TPU_DIST_CHECKPOINT_DIR`` a restarted run resumes and its
     ``final_loss`` matches the uninterrupted run's exactly.
     """
+    import contextlib
+
     from tpu_dist.models.cnn import build_and_compile_cnn_model
 
     epochs = _env_int("TPU_DIST_DEMO_EPOCHS", 3)
@@ -83,10 +187,30 @@ def demo_train() -> dict:
     # Dataset cardinality == steps_per_epoch: the load-bearing determinism
     # property (module docstring) — every epoch consumes exactly one pass.
     ds = demo_dataset(n=batch * steps_per_epoch, batch=batch)
-    model = build_and_compile_cnn_model(learning_rate=0.01)
-    history = model.fit(
-        ds, epochs=epochs, steps_per_epoch=steps_per_epoch, verbose=0,
-        checkpoint_dir=os.environ.get(CHECKPOINT_DIR_ENV))
+    # The elastic/reshape chaos plans run data-parallel over however many
+    # devices THIS attempt's launcher provisioned (the Supervisor resizes
+    # the gang between attempts via XLA_FLAGS) — losses are insensitive to
+    # the device count because the global batch is fixed, so a run resumed
+    # on a different mesh still reproduces the baseline bit-for-bit.
+    scope = contextlib.nullcontext()
+    if os.environ.get("TPU_DIST_DEMO_STRATEGY", "").lower() == "mirrored":
+        from tpu_dist.parallel.strategy import MirroredStrategy
+
+        scope = MirroredStrategy().scope()
+    with scope:
+        model = build_and_compile_cnn_model(learning_rate=0.01)
+        callbacks = []
+        ckpt_dir = os.environ.get(CHECKPOINT_DIR_ENV)
+        if ckpt_dir and os.environ.get("TPU_DIST_DEMO_SHARDED") == "1":
+            from tpu_dist.training.callbacks import ModelCheckpoint
+
+            # Passing the callback explicitly (same dir) suppresses fit's
+            # auto-appended v1 ModelCheckpoint — the per-epoch saves then
+            # exercise the v2 sharded layout reshape-on-restore stitches.
+            callbacks.append(ModelCheckpoint(ckpt_dir, sharded=True))
+        history = model.fit(
+            ds, epochs=epochs, steps_per_epoch=steps_per_epoch, verbose=0,
+            callbacks=callbacks, checkpoint_dir=ckpt_dir)
     losses = [round(float(l), 10) for l in history.history.get("loss", [])]
     return {
         "final_loss": losses[-1] if losses else None,
@@ -100,10 +224,16 @@ def run_entry(fn: Callable[[], Optional[dict]]) -> int:
 
     Emits the ``RESULT:`` line on success; maps PeerUnavailableError to
     EXIT_PEER_UNAVAILABLE (logged as ``peer_unavailable``) and any other
-    exception to 1 (logged as ``worker_error``).
+    exception to 1 (logged as ``worker_error``). Arms the SIGTERM seam
+    first: a run that a preemption notice drained returns
+    :data:`EXIT_PREEMPTED` (logged as ``preempt_drained`` with the
+    measured drain duration) and emits NO ``RESULT:`` line — the run did
+    not finish; its checkpoint, published during the drain, is the
+    hand-off to the restarted attempt.
     """
     from tpu_dist.cluster.liveness import PeerUnavailableError
 
+    install_sigterm_handler()
     try:
         result = fn()
     except PeerUnavailableError as exc:
@@ -117,6 +247,20 @@ def run_entry(fn: Callable[[], Optional[dict]]) -> int:
 
         traceback.print_exc()
         return 1
+    if preemption_requested():
+        # fit() returned because PreemptionDrain stopped it; every callback
+        # (including ModelCheckpoint's async close) has already finalized,
+        # so the last epoch-boundary checkpoint is published by now.
+        drain_s = time.monotonic() - (preemption_requested_at() or 0.0)
+        from tpu_dist.observe import metrics as metrics_lib
+
+        metrics_lib.observe_value("elastic.drain_s", drain_s)
+        events.maybe_log("preempt_drained", drain_s=round(drain_s, 6),
+                         attempt=events.current_attempt())
+        print(f"tpu_dist.resilience: drain complete in {drain_s:.3f}s; "
+              f"exiting {EXIT_PREEMPTED} (preempted)",
+              file=sys.stderr, flush=True)
+        return EXIT_PREEMPTED
     if result is not None:
         print("RESULT:" + json.dumps(result), flush=True)
     return 0
@@ -141,4 +285,11 @@ def resolve_entry() -> Callable[[], Optional[dict]]:
 
 
 if __name__ == "__main__":
-    sys.exit(run_entry(resolve_entry()))
+    # Delegate to the canonical module instance: under ``python -m`` this
+    # file executes as ``__main__``, a SECOND module object — arming the
+    # preemption seam here would leave the instance the trainer imports
+    # (tpu_dist.resilience.entrypoints, via maybe_preemption_drain) unarmed
+    # and the drain callback permanently off.
+    from tpu_dist.resilience import entrypoints as _canonical
+
+    sys.exit(_canonical.run_entry(_canonical.resolve_entry()))

@@ -26,7 +26,14 @@ Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
 
 ``kill``
     ``os._exit(exit_code)`` at the target step — a hard worker death with no
-    Python cleanup, the preemption analog.
+    Python cleanup, the ungraceful-preemption analog.
+``preempt``
+    ``os.kill(os.getpid(), SIGTERM)`` at the target step — the GRACEFUL
+    preemption: the real signal is delivered, so the worker's SIGTERM seam
+    (:mod:`tpu_dist.resilience.entrypoints`) runs the production drain path
+    — stop at the next step boundary, publish any in-flight checkpoint,
+    exit :data:`EXIT_PREEMPTED`. Chaos plans use this to prove a preempted
+    worker publishes before dying.
 ``delay_collective`` / ``hang_collective``
     Sleep inside the host-level collective seam
     (:func:`tpu_dist.parallel.collectives.install_fault_hook`) — barriers,
@@ -57,12 +64,15 @@ from typing import Optional, Sequence
 
 #: Canonical fault kinds. CLI aliases (kill-worker, ckpt-fail, ...) normalize
 #: onto these names.
-KINDS = ("kill", "delay_collective", "hang_collective", "checkpoint_fail",
-         "kill_during_save", "slow_input")
+KINDS = ("kill", "preempt", "delay_collective", "hang_collective",
+         "checkpoint_fail", "kill_during_save", "slow_input")
 
 _ALIASES = {
     "kill-worker": "kill",
     "kill_worker": "kill",
+    "preempt-worker": "preempt",
+    "preempt_worker": "preempt",
+    "sigterm": "preempt",
     "delay-collective": "delay_collective",
     "hang-collective": "hang_collective",
     "ckpt-fail": "checkpoint_fail",
@@ -84,6 +94,14 @@ EXIT_FAULT_KILL = 43
 #: Exit code of a worker that surrendered after detecting a dead peer
 #: (liveness verdict) — the supervisor restarts these, they are victims.
 EXIT_PEER_UNAVAILABLE = 17
+
+#: Exit code of a worker that received SIGTERM and completed the graceful
+#: drain — stopped at a step boundary with every in-flight checkpoint
+#: published. Nonzero on purpose: a preempted worker did NOT finish its
+#: training run, so the supervisor must restart the gang (possibly at a
+#: different size); it is merely a *clean* restart, distinguishable from
+#: ``fault_kill``/``signal_N`` in ``Supervisor.classify_exit``.
+EXIT_PREEMPTED = 19
 
 #: "hang" is implemented as a bounded very-long delay: long enough that the
 #: supervisor's per-attempt deadline is what ends it, short enough that an

@@ -115,9 +115,12 @@ class FaultInjector(Callback):
         self._epoch = epoch
         self._global_step = epoch * self.steps_per_epoch
         for i, f in enumerate(self.faults):
-            if (f.kind == "kill" and self._remaining[i] > 0
+            if (f.kind in ("kill", "preempt") and self._remaining[i] > 0
                     and f.step is None and f.due_at_epoch(epoch)):
-                self._fire_kill(i, f, at=f"epoch {epoch}")
+                if f.kind == "kill":
+                    self._fire_kill(i, f, at=f"epoch {epoch}")
+                else:
+                    self._fire_preempt(i, f, at=f"epoch {epoch}")
 
     def on_batch_end(self, step: int, logs: dict) -> None:
         # ``step`` is the in-epoch index of the last step in the execution
@@ -132,6 +135,8 @@ class FaultInjector(Callback):
                 continue
             if f.kind == "kill":
                 self._fire_kill(i, f, at=f"step {gstep}")
+            elif f.kind == "preempt":
+                self._fire_preempt(i, f, at=f"step {gstep}")
             elif f.kind == "slow_input":
                 self._remaining[i] -= 1
                 self._log("fault_fired", kind=f.kind, step=gstep,
@@ -144,6 +149,35 @@ class FaultInjector(Callback):
         logger.warning("fault injection: killing process at %s "
                        "(exit %d)", at, f.exit_code)
         os._exit(f.exit_code)
+
+    def _fire_preempt(self, i: int, f: FaultSpec, *, at: str) -> None:
+        """Deliver a REAL SIGTERM to this process — the graceful preemption.
+
+        Unlike ``kill`` this does not end the process here: the SIGTERM seam
+        (:func:`tpu_dist.resilience.entrypoints.install_sigterm_handler`)
+        records the request and the :class:`PreemptionDrain` callback stops
+        training at this very step boundary, so the whole production drain
+        path runs under the fault. Without the seam installed, SIGTERM's
+        default action kills the process (exit -15) — also a legitimate
+        chaos outcome (an UNgraceful worker).
+        """
+        import signal
+
+        self._remaining[i] -= 1
+        self._log("fault_fired", kind="preempt", at=at)
+        logger.warning("fault injection: delivering SIGTERM to self at %s",
+                       at)
+        os.kill(os.getpid(), signal.SIGTERM)
+        # The Python-level handler runs on this (main) thread at the next
+        # bytecode boundary; yield until it has, so the drain callback later
+        # in this same callback round deterministically sees the request.
+        from tpu_dist.resilience import entrypoints
+
+        if entrypoints.preemption_armed():
+            deadline = time.monotonic() + 5.0
+            while (not entrypoints.preemption_requested()
+                   and time.monotonic() < deadline):
+                time.sleep(0.001)
 
     # -- seam hooks ----------------------------------------------------------
 
@@ -237,3 +271,113 @@ def maybe_injector_from_env(*, steps_per_epoch: int,
     logger.info("fault plan armed for rank %d attempt %d: %d fault(s)",
                 rank, attempt, len(mine))
     return FaultInjector(mine, steps_per_epoch=steps_per_epoch)
+
+
+class PreemptionDrain(Callback):
+    """Stops training at the first step boundary after a SIGTERM.
+
+    The signal handler (:func:`tpu_dist.resilience.entrypoints.
+    install_sigterm_handler`) only *records* the preemption notice — a signal
+    handler cannot safely unwind a training loop that may be inside XLA. This
+    callback is the loop-side half of the seam: every step boundary it checks
+    the flag and raises :class:`StopTraining`, which ``fit`` catches; the
+    ``finally: on_train_end()`` path then closes :class:`ModelCheckpoint`,
+    joining and PUBLISHING any in-flight async save before the process exits
+    ``EXIT_PREEMPTED``.
+
+    Parity note: the drain deliberately does NOT write a new checkpoint for
+    the partially-trained epoch. Resume is epoch-granular (epoch-keyed RNG,
+    epoch-boundary saves), so publishing mid-epoch state would double-train
+    part of an epoch after restore. The interrupted epoch is replayed
+    identically instead — that is what keeps the chaos gate's exact loss
+    parity honest.
+    """
+
+    wants_batches = True
+
+    def on_batch_end(self, step: int, logs: dict) -> None:
+        self._maybe_stop(f"step boundary (in-epoch step {step})")
+
+    def on_epoch_begin(self, epoch: int) -> None:
+        # Covers a SIGTERM that lands between epochs (e.g. during eval or
+        # checkpointing) — don't start another epoch just to notice it.
+        self._maybe_stop(f"epoch {epoch} boundary")
+
+    def _maybe_stop(self, where: str) -> None:
+        from tpu_dist.resilience import entrypoints
+        from tpu_dist.training.callbacks import StopTraining
+
+        if entrypoints.preemption_requested():
+            logger.warning("preemption drain: stopping training at %s",
+                           where)
+            raise StopTraining(f"preempted (drained at {where})")
+
+
+def maybe_preemption_drain() -> Optional[PreemptionDrain]:
+    """A :class:`PreemptionDrain` when the SIGTERM seam is armed (i.e. the
+    process was launched through ``run_entry``), else None — an unsupervised
+    notebook ``fit`` pays no per-batch hook for a handler that isn't there."""
+    from tpu_dist.resilience import entrypoints
+
+    if not entrypoints.preemption_armed():
+        return None
+    return PreemptionDrain()
+
+
+class RejoinGate(Callback):
+    """Epoch-boundary rendezvous: holds every worker at ``on_epoch_begin``
+    until the whole gang has arrived, so a recovered worker re-enters the
+    loop at the *next* epoch boundary instead of forcing a full gang restart.
+
+    The barrier is the file-based :func:`tpu_dist.cluster.bootstrap.
+    epoch_rendezvous` — deliberately NOT a jax collective, because the whole
+    point is that the rejoining worker is a fresh process that is not (yet)
+    part of any collective clique. Survivors publish their epoch marker and
+    wait; the relaunched worker restores the shared checkpoint, publishes its
+    own marker for the epoch it resumes at, and from that boundary on the
+    gang steps together again.
+    """
+
+    def __init__(self, directory: str, *, world: Optional[int] = None,
+                 rank: Optional[int] = None, timeout_s: float = 120.0):
+        self.directory = directory
+        self.world = world
+        self.rank = rank
+        self.timeout_s = float(timeout_s)
+
+    def on_epoch_begin(self, epoch: int) -> None:
+        from tpu_dist.cluster import bootstrap
+        from tpu_dist.observe import metrics as metrics_lib
+
+        t0 = time.monotonic()
+        ranks = bootstrap.epoch_rendezvous(
+            self.directory, epoch=epoch, rank=self.rank, world=self.world,
+            timeout_s=self.timeout_s)
+        wait_s = time.monotonic() - t0
+        metrics_lib.observe_value("elastic.rejoin_wait_s", wait_s)
+        log = events.log_from_env()
+        if log is not None:
+            log.append("rejoin_rendezvous", attempt=events.current_attempt(),
+                       epoch=epoch, ranks=ranks, wait_s=round(wait_s, 6))
+
+
+def maybe_rejoin_gate() -> Optional[RejoinGate]:
+    """A :class:`RejoinGate` when ``$TPU_DIST_REJOIN_DIR`` names the
+    rendezvous directory, else None. ``$TPU_DIST_REJOIN_WORLD`` /
+    ``$TPU_DIST_REJOIN_RANK`` override the gang coordinates (they default to
+    ``jax.process_count()`` / ``jax.process_index()``, which is right for
+    real multi-process gangs but not for supervised single-process workers
+    that each see themselves as process 0); ``$TPU_DIST_REJOIN_TIMEOUT_S``
+    bounds the wait (default 120)."""
+    from tpu_dist.cluster import bootstrap
+
+    directory = os.environ.get(bootstrap.REJOIN_DIR_ENV)
+    if not directory:
+        return None
+    world = os.environ.get("TPU_DIST_REJOIN_WORLD")
+    rank = os.environ.get("TPU_DIST_REJOIN_RANK")
+    timeout_s = float(os.environ.get("TPU_DIST_REJOIN_TIMEOUT_S", "120"))
+    return RejoinGate(directory,
+                      world=int(world) if world else None,
+                      rank=int(rank) if rank else None,
+                      timeout_s=timeout_s)

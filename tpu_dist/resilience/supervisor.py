@@ -42,13 +42,14 @@ from typing import Optional, Sequence
 
 from tpu_dist.resilience import events
 from tpu_dist.resilience.faults import (EXIT_FAULT_KILL,
-                                        EXIT_PEER_UNAVAILABLE)
+                                        EXIT_PEER_UNAVAILABLE,
+                                        EXIT_PREEMPTED)
 
 logger = logging.getLogger("tpu_dist.resilience")
 
 #: How long a surviving rank gets to exit on its own after a gang member
-#: died, before the supervisor kills it (it is usually wedged in a
-#: collective waiting for the dead peer).
+#: died, before the supervisor escalates (see :class:`GracePolicy`; it is
+#: usually wedged in a collective waiting for the dead peer).
 GANG_GRACE_S = 5.0
 
 _POLL_S = 0.1
@@ -70,17 +71,50 @@ class BackoffPolicy:
         return min(self.max_s, self.initial_s * self.multiplier ** restart)
 
 
+@dataclasses.dataclass(frozen=True)
+class GracePolicy:
+    """How a condemned gang is taken down: the spot-fleet preemption contract.
+
+    The supervisor first waits ``exit_grace_s`` for survivors to exit on
+    their own, then delivers SIGTERM — which a worker launched through
+    ``run_entry`` answers with the graceful drain (stop at the next step
+    boundary, publish in-flight checkpoints, exit
+    :data:`~tpu_dist.resilience.faults.EXIT_PREEMPTED`) — waits
+    ``term_grace_s`` for the drain, and only then escalates to SIGKILL.
+    A deadline-hit (hung) attempt skips straight to SIGKILL: its main
+    thread is wedged, so the Python-level SIGTERM drain cannot run and
+    waiting the term grace would just slow every hang-chaos run down.
+    """
+
+    exit_grace_s: float = GANG_GRACE_S
+    term_grace_s: float = 10.0
+
+
 @dataclasses.dataclass
 class AttemptOutcome:
     attempt: int
     exit_codes: list
     duration_s: float
     deadline_hit: bool = False
+    #: Gang shape this attempt ran at (elastic schedules vary these).
+    num_workers: Optional[int] = None
+    device_count: Optional[int] = None
+    #: Per-rank relaunches absorbed without a gang restart.
+    rejoins: int = 0
+    #: Longest SIGTERM→drained duration any rank of this attempt reported
+    #: (from ``preempt_drained`` events); None when nothing drained.
+    drain_s: Optional[float] = None
 
     @property
     def succeeded(self) -> bool:
         return (not self.deadline_hit
                 and all(c == 0 for c in self.exit_codes))
+
+    @property
+    def preempted(self) -> bool:
+        """True when every nonzero exit was a clean SIGTERM drain."""
+        nonzero = [c for c in self.exit_codes if c != 0]
+        return bool(nonzero) and all(c == EXIT_PREEMPTED for c in nonzero)
 
 
 @dataclasses.dataclass
@@ -103,6 +137,14 @@ class SupervisorReport:
             "recovery_wall_s": (None if self.recovery_wall_s is None
                                 else round(self.recovery_wall_s, 3)),
             "exit_codes": [o.exit_codes for o in self.outcomes],
+            "exit_kinds": [[classify_exit(c) for c in o.exit_codes]
+                           for o in self.outcomes],
+            "gang_shapes": [{"num_workers": o.num_workers,
+                             "device_count": o.device_count}
+                            for o in self.outcomes],
+            "rejoins": [o.rejoins for o in self.outcomes],
+            "drain_s": [None if o.drain_s is None else round(o.drain_s, 3)
+                        for o in self.outcomes],
         }
 
 
@@ -121,6 +163,8 @@ def classify_exit(code: Optional[int]) -> str:
         return "fault_kill"
     if code == EXIT_PEER_UNAVAILABLE:
         return "peer_unavailable"
+    if code == EXIT_PREEMPTED:
+        return "preempted"
     if code is not None and code < 0:
         return f"signal_{-code}"
     return "crash"
@@ -147,24 +191,66 @@ class Supervisor:
                  max_restarts: int = 3,
                  attempt_deadline_s: Optional[float] = None,
                  backoff: BackoffPolicy = BackoffPolicy(),
+                 grace: GracePolicy = GracePolicy(),
                  env: Optional[dict] = None,
                  log_dir: str | os.PathLike = "resilience-logs",
                  event_log: Optional[events.EventLog] = None,
-                 observe_dir: Optional[str | os.PathLike] = None):
+                 observe_dir: Optional[str | os.PathLike] = None,
+                 worker_schedule: Optional[Sequence[int]] = None,
+                 device_schedule: Optional[Sequence[int]] = None,
+                 rejoin_window_s: float = 0.0,
+                 max_rejoins: int = 4):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         if max_restarts < 0:
             raise ValueError("max_restarts must be >= 0")
+        for name, sched in (("worker_schedule", worker_schedule),
+                            ("device_schedule", device_schedule)):
+            if sched is not None and (
+                    not sched or any(int(n) < 1 for n in sched)):
+                raise ValueError(
+                    f"{name} must be a non-empty sequence of positive "
+                    f"ints, got {sched!r}")
         self.cmd = list(cmd)
         self.num_workers = num_workers
         self.max_restarts = max_restarts
         self.attempt_deadline_s = attempt_deadline_s
         self.backoff = backoff
+        self.grace = grace
         self.env = dict(env or {})
         self.log_dir = pathlib.Path(log_dir)
         self.events = event_log
         self.observe_dir = (pathlib.Path(observe_dir)
                             if observe_dir is not None else None)
+        #: Elastic schedules: entry ``a`` is the gang shape for attempt
+        #: ``a`` (the last entry repeats for later attempts), so a chaos
+        #: plan can RESHAPE the job across a restart — fewer/more worker
+        #: processes, or fewer/more devices per worker (the CPU-backend
+        #: reshape vehicle: ``--xla_force_host_platform_device_count``).
+        self.worker_schedule = (None if worker_schedule is None
+                                else [int(n) for n in worker_schedule])
+        self.device_schedule = (None if device_schedule is None
+                                else [int(n) for n in device_schedule])
+        #: Per-rank relaunch: with ``rejoin_window_s > 0`` a non-chief
+        #: worker that dies while the rest of the gang keeps running is
+        #: relaunched into the SAME attempt (it rejoins at the next epoch
+        #: rendezvous) instead of condemning the gang.
+        self.rejoin_window_s = float(rejoin_window_s)
+        self.max_rejoins = int(max_rejoins)
+
+    # -- elastic gang shapes -------------------------------------------------
+
+    def gang_size(self, attempt: int) -> int:
+        """Worker count for ``attempt`` (worker_schedule, else static)."""
+        if self.worker_schedule is None:
+            return self.num_workers
+        return self.worker_schedule[min(attempt, len(self.worker_schedule) - 1)]
+
+    def device_count(self, attempt: int) -> Optional[int]:
+        """Per-worker forced device count for ``attempt``, or None."""
+        if self.device_schedule is None:
+            return None
+        return self.device_schedule[min(attempt, len(self.device_schedule) - 1)]
 
     # -- launching -----------------------------------------------------------
 
@@ -176,7 +262,8 @@ class Supervisor:
             from tpu_dist.observe.telemetry import OBSERVE_DIR_ENV
 
             env[OBSERVE_DIR_ENV] = str(self.observe_dir / f"rank{rank}")
-        if self.num_workers > 1:
+        workers = self.gang_size(attempt)
+        if workers > 1:
             from tpu_dist.cluster.config import make_local_cluster
 
             # Fresh ports every attempt: rank 0 hosted the coordination
@@ -184,32 +271,45 @@ class Supervisor:
             # sit in TIME_WAIT.
             if rank == 0:
                 self._base_port = _free_port()
-            cfg = make_local_cluster(
-                self.num_workers, base_port=self._base_port)[rank]
+            cfg = make_local_cluster(workers, base_port=self._base_port)[rank]
             env.update({
                 "TF_CONFIG": json.dumps(cfg),
                 "JAX_PLATFORMS": "cpu",
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
                 "PALLAS_AXON_POOL_IPS": "",
             })
+        devices = self.device_count(attempt)
+        if devices is not None:
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS":
+                    f"--xla_force_host_platform_device_count={devices}",
+            })
         return env
 
-    def worker_log(self, attempt: int, rank: int) -> pathlib.Path:
-        return self.log_dir / f"attempt{attempt}-rank{rank}.log"
+    def worker_log(self, attempt: int, rank: int,
+                   rejoin: int = 0) -> pathlib.Path:
+        suffix = f"-rejoin{rejoin}" if rejoin else ""
+        return self.log_dir / f"attempt{attempt}-rank{rank}{suffix}.log"
+
+    def _spawn(self, rank: int, attempt: int,
+               rejoin: int = 0) -> subprocess.Popen:
+        log_path = self.worker_log(attempt, rank, rejoin)
+        # The file object can close right after spawn; the child holds
+        # its own descriptor.
+        with open(log_path, "wb") as log:
+            return subprocess.Popen(
+                self.cmd, env=self._worker_env(rank, attempt),
+                stdout=log, stderr=subprocess.STDOUT)
 
     def _launch(self, attempt: int) -> list:
         self.log_dir.mkdir(parents=True, exist_ok=True)
-        procs = []
-        for rank in range(self.num_workers):
-            log_path = self.worker_log(attempt, rank)
-            # The file object can close right after spawn; the child holds
-            # its own descriptor.
-            with open(log_path, "wb") as log:
-                procs.append(subprocess.Popen(
-                    self.cmd, env=self._worker_env(rank, attempt),
-                    stdout=log, stderr=subprocess.STDOUT))
+        procs = [self._spawn(rank, attempt)
+                 for rank in range(self.gang_size(attempt))]
         self._log("attempt_start", attempt=attempt,
-                  pids=[p.pid for p in procs])
+                  pids=[p.pid for p in procs],
+                  num_workers=self.gang_size(attempt),
+                  device_count=self.device_count(attempt))
         return procs
 
     def _log(self, event: str, **fields) -> None:
@@ -221,30 +321,60 @@ class Supervisor:
 
     # -- watching ------------------------------------------------------------
 
+    def _can_rejoin(self, rank: int, code: int, rejoins: int,
+                    live_others: bool) -> bool:
+        """Per-rank relaunch eligibility: rejoin mode armed, budget left,
+        the rest of the gang still running, and not the chief — rank 0
+        hosts the coordination service, so its death takes the clique's
+        rendezvous medium with it and only a gang restart recovers."""
+        return (self.rejoin_window_s > 0
+                and rejoins < self.max_rejoins
+                and rank != 0
+                and live_others
+                and code != 0)
+
     def _watch(self, procs: list, attempt: int) -> AttemptOutcome:
         """Block until the gang exits, a member fails, or the deadline hits.
 
         Gang semantics: the first nonzero exit (or the deadline) condemns
-        the attempt — survivors get GANG_GRACE_S to exit on their own, then
-        are killed.
+        the attempt — unless rejoin mode can absorb it as a per-rank
+        relaunch — after which survivors get the :class:`GracePolicy`
+        escalation (exit grace → SIGTERM drain → term grace → SIGKILL).
         """
         t0 = time.monotonic()
         deadline = (t0 + self.attempt_deadline_s
                     if self.attempt_deadline_s else None)
         failed = False
         deadline_hit = False
+        rejoins = 0
         reported: set = set()
         while True:
             live = [p for p in procs if p.poll() is None]
             for rank, p in enumerate(procs):
                 code = p.poll()
-                if code is not None and rank not in reported:
-                    reported.add(rank)
+                if code is not None and (rank, p.pid) not in reported:
+                    reported.add((rank, p.pid))
                     self._log("worker_exit", attempt=attempt, rank=rank,
                               code=code, kind=classify_exit(code))
                     logger.info("supervisor: rank %d exited %s (%s)",
                                 rank, code, classify_exit(code))
-                    if code != 0:
+                    if code == 0:
+                        continue
+                    others_live = any(q.poll() is None for q in procs
+                                      if q is not p)
+                    if self._can_rejoin(rank, code, rejoins, others_live):
+                        rejoins += 1
+                        procs[rank] = self._spawn(rank, attempt,
+                                                  rejoin=rejoins)
+                        self._log("worker_rejoin", attempt=attempt,
+                                  rank=rank, rejoin=rejoins,
+                                  prior_code=code,
+                                  pid=procs[rank].pid)
+                        logger.info(
+                            "supervisor: relaunched rank %d into attempt "
+                            "%d (rejoin %d/%d)", rank, attempt, rejoins,
+                            self.max_rejoins)
+                    else:
                         failed = True
             if failed or not live:
                 break
@@ -256,23 +386,63 @@ class Supervisor:
                                "deadline", attempt, self.attempt_deadline_s)
                 break
             time.sleep(_POLL_S)
-        # Grace period, then kill whoever is left.
-        grace_end = time.monotonic() + (0 if deadline_hit else GANG_GRACE_S)
-        for p in procs:
-            while p.poll() is None and time.monotonic() < grace_end:
+        # GracePolicy escalation for whoever is left. A deadline-hit gang
+        # is wedged — skip straight to SIGKILL (GracePolicy docstring).
+        if deadline_hit:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        else:
+            grace_end = time.monotonic() + self.grace.exit_grace_s
+            while (any(p.poll() is None for p in procs)
+                   and time.monotonic() < grace_end):
                 time.sleep(_POLL_S)
-            if p.poll() is None:
-                p.kill()
+            termed = [rank for rank, p in enumerate(procs)
+                      if p.poll() is None]
+            if termed:
+                for rank in termed:
+                    procs[rank].terminate()  # SIGTERM: the drain request
+                self._log("gang_sigterm", attempt=attempt, ranks=termed,
+                          term_grace_s=self.grace.term_grace_s)
+                logger.info("supervisor: SIGTERM to rank(s) %s; waiting "
+                            "%.1fs for the drain", termed,
+                            self.grace.term_grace_s)
+                term_end = time.monotonic() + self.grace.term_grace_s
+                while (any(p.poll() is None for p in procs)
+                       and time.monotonic() < term_end):
+                    time.sleep(_POLL_S)
+            for rank, p in enumerate(procs):
+                if p.poll() is None:
+                    self._log("gang_sigkill", attempt=attempt, rank=rank)
+                    p.kill()
         codes = []
         for rank, p in enumerate(procs):
             code = p.wait()
             codes.append(code)
-            if rank not in reported:
+            if (rank, p.pid) not in reported:
                 self._log("worker_exit", attempt=attempt, rank=rank,
                           code=code, kind=classify_exit(code))
         return AttemptOutcome(attempt=attempt, exit_codes=codes,
                               duration_s=time.monotonic() - t0,
-                              deadline_hit=deadline_hit)
+                              deadline_hit=deadline_hit,
+                              num_workers=self.gang_size(attempt),
+                              device_count=self.device_count(attempt),
+                              rejoins=rejoins)
+
+    def _attempt_drain_s(self, attempt: int) -> Optional[float]:
+        """Longest drain any rank of ``attempt`` reported, from the shared
+        event log's ``preempt_drained`` records; None without the log."""
+        if self.events is None:
+            return None
+        try:
+            drained = [e.get("drain_s") for e in
+                       events.read_events(self.events.path,
+                                          event="preempt_drained")
+                       if e.get("attempt") == attempt
+                       and isinstance(e.get("drain_s"), (int, float))]
+        except OSError:
+            return None
+        return max(drained) if drained else None
 
     # -- the supervision loop ------------------------------------------------
 
@@ -283,6 +453,7 @@ class Supervisor:
         attempt = 0
         while True:
             outcome = self._watch(self._launch(attempt), attempt)
+            outcome.drain_s = self._attempt_drain_s(attempt)
             outcomes.append(outcome)
             if outcome.succeeded:
                 if attempt > 0:

@@ -237,6 +237,10 @@ def _write_v1_checkpoint(directory: pathlib.Path, flat: dict,
             "step": step,
             "keys": sorted(flat),
             "format": _FORMAT_V1,
+            # Topology stamp: lets restore_model detect (and count) a
+            # reshape — resuming on a different gang/device shape.
+            "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
         }))
         _fire_write_fault(tmp_path, step)
         _publish_stage(tmp_path, target, directory, step)
@@ -368,6 +372,7 @@ def _write_sharded_stage(stage: pathlib.Path, saveable, *, step: int) -> None:
             "step": step,
             "format": _FORMAT_V2,
             "process_count": jax.process_count(),
+            "device_count": jax.device_count(),
             "leaves": meta,
         }))
 
@@ -726,6 +731,16 @@ def _iter_sharded_leaves(target: pathlib.Path):
             filled = 0
             for e in entries:
                 data = load_from(e["file"], e["name"])
+                want = tuple(b - a for a, b in e["slices"])
+                if tuple(data.shape) != want:
+                    raise ValueError(
+                        f"sharded checkpoint {target}: shard "
+                        f"{e['name']!r} of {k!r} has shape "
+                        f"{tuple(data.shape)} but its index claims slices "
+                        f"{e['slices']} ({want}) — shard index and data "
+                        "disagree (mixed checkpoint generations, or a "
+                        "corrupted shard file); refusing to assemble a "
+                        "torn state")
                 sl = tuple(slice(a, b) for a, b in e["slices"])
                 out[sl] = data
                 filled += data.size
@@ -948,6 +963,60 @@ def restore(directory: str | os.PathLike, template: Any, *,
     return restored, step
 
 
+def _check_divisible_placement(strategy, host,
+                               sharded_keys: frozenset | set = frozenset()
+                               ) -> None:
+    """Reject a reshape-restore that would SILENTLY degrade placement.
+
+    ``prune_indivisible`` replaces any spec whose sharded dim doesn't tile
+    evenly with replicated — the right degradation for live construction,
+    but on the RESTORE path it would quietly absorb a bad elastic reshape
+    (e.g. a 48-row TP leaf relaunched on a 5-wide model axis) as a
+    replicated tree with a different memory/step profile than the job that
+    saved. Only leaves the checkpoint actually stored SHARDED
+    (``sharded_keys``, from the v2 manifest) are held to this bar: a leaf
+    the saving job already replicated (its dim never tiled — e.g. a
+    vocab-sized bias) keeps degrading gracefully, as does a spec naming an
+    axis the new mesh simply doesn't have (restoring a TP checkpoint onto
+    a data-only mesh is supported). v1 checkpoints carry no per-leaf
+    sharding, so they pass ``frozenset()`` and skip the check — they
+    always stored a gathered global copy."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_dist.parallel import tensor as tensor_lib
+
+    mesh = getattr(strategy, "_mesh", None)
+    if mesh is None or not sharded_keys:
+        return
+    specs = tensor_lib.specs_like_params(
+        host, strategy.param_spec_tree(host["params"]))
+
+    def check(path, spec, leaf):
+        if jax.tree_util.keystr(path) not in sharded_keys:
+            return  # stored replicated: no placement to lose
+        shape = getattr(leaf, "shape", ())
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            axes = (axis,) if isinstance(axis, str) else tuple(axis)
+            if any(ax not in mesh.shape for ax in axes):
+                continue  # axis gone entirely: graceful replication
+            div = 1
+            for ax in axes:
+                div *= mesh.shape[ax]
+            if dim < len(shape) and shape[dim] % div:
+                key = jax.tree_util.keystr(path)
+                raise ValueError(
+                    f"cannot reshape-restore {key}: dimension {dim} of "
+                    f"shape {tuple(shape)} does not divide mesh axis "
+                    f"{axis!r} (size {div}) — relaunch with a worker/"
+                    "device count whose axis sizes divide every sharded "
+                    "dimension, or restore on the original topology")
+
+    jax.tree_util.tree_map_with_path(
+        check, specs, host, is_leaf=lambda x: isinstance(x, P))
+
+
 def restore_model(directory: str | os.PathLike, model, *,
                   step: Optional[int] = None, trainer=None) -> int:
     """Restore a compiled model's training variables in place (resume).
@@ -955,7 +1024,18 @@ def restore_model(directory: str | os.PathLike, model, *,
     ``trainer`` pins which Trainer's variables receive the restored state —
     required when a Trainer other than ``model._trainer`` is driving (e.g.
     the running trainer inside ``fit(checkpoint_dir=...)``); defaults to the
-    model's own trainer."""
+    model's own trainer.
+
+    Elastic reshape: the restored host tree is GLOBAL (v1 by construction;
+    v2 stitched from the per-process shard files), so placement works on
+    any target mesh — restoring a checkpoint written by P processes /
+    D devices onto Q≠P / E≠D re-shards the same global state. Optimizer
+    moments ride along (they inherit the params' specs by path suffix) and
+    RNG needs no state at all: the trainer derives its per-epoch keys from
+    ``seed`` and the epoch index, so a reshaped resume replays the exact
+    key sequence of the original job. A reshape that would force a SILENT
+    placement degradation (sharded dim not divisible by the new axis size)
+    raises instead — see :func:`_check_divisible_placement`."""
     from tpu_dist.training.trainer import Trainer
 
     if trainer is None:
@@ -966,6 +1046,11 @@ def restore_model(directory: str | os.PathLike, model, *,
     v = trainer.variables
     template = {k: v[k] for k in ("params", "state", "opt") if k in v}
     host, step = restore(directory, template, step=step)
+    manifest = _manifest(_step_dir(pathlib.Path(directory), step))
+    sharded_keys = {k for k, m in manifest.get("leaves", {}).items()
+                    if m.get("sharded")}
+    _check_divisible_placement(trainer.strategy, host, sharded_keys)
+    _note_reshape(pathlib.Path(directory), step)
     # Strategy-owned placement: mirrored on a data mesh, Megatron shards
     # under a 'model' axis — a TP job must NOT come back replicated (it
     # would multiply per-device param+moment memory by the model-axis size
@@ -975,3 +1060,31 @@ def restore_model(directory: str | os.PathLike, model, *,
     for k in template:
         v[k] = placed[k]
     return step
+
+
+def _note_reshape(directory: pathlib.Path, step: int) -> None:
+    """Count/record a topology-changing restore, from the manifest's
+    topology stamp (older checkpoints without one are simply not counted).
+    Observability only — never fails the restore."""
+    try:
+        manifest = _manifest(_step_dir(directory, step))
+        saved_procs = manifest.get("process_count")
+        saved_devs = manifest.get("device_count")
+        now_procs, now_devs = jax.process_count(), jax.device_count()
+        reshaped = ((saved_procs is not None and saved_procs != now_procs)
+                    or (saved_devs is not None and saved_devs != now_devs))
+        if not reshaped:
+            return
+        metrics_lib.inc("elastic.reshape_restores")
+        logger.info(
+            "reshape-restore of step %d: saved on %s process(es) / %s "
+            "device(s), restoring on %d / %d", step, saved_procs,
+            saved_devs, now_procs, now_devs)
+        from tpu_dist.resilience import events
+
+        events.maybe_log("reshape_restore", step=step,
+                         saved_process_count=saved_procs,
+                         saved_device_count=saved_devs,
+                         process_count=now_procs, device_count=now_devs)
+    except OSError:
+        pass

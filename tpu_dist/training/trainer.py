@@ -518,12 +518,27 @@ class Trainer:
         # Code-edit-free chaos wiring (tpu_dist.resilience): a fault plan in
         # $TPU_DIST_FAULT_PLAN — set by the resilience CLI / Supervisor —
         # rides this fit as one more callback. None in production runs.
-        from tpu_dist.resilience.injector import maybe_injector_from_env
+        from tpu_dist.resilience.injector import (maybe_injector_from_env,
+                                                  maybe_preemption_drain,
+                                                  maybe_rejoin_gate)
 
         fault_injector = maybe_injector_from_env(
             steps_per_epoch=steps_per_epoch)
         if fault_injector is not None:
             callbacks.append(fault_injector)
+        # Graceful-preemption drain: armed only when the SIGTERM seam is
+        # installed (run_entry workers), so a notebook fit pays nothing.
+        # Appended AFTER the injector so an injected `preempt` fault is
+        # observed by the drain in the same step-boundary callback round.
+        drain = maybe_preemption_drain()
+        if drain is not None:
+            callbacks.append(drain)
+        # Elastic epoch-boundary rejoin: $TPU_DIST_REJOIN_DIR (set by the
+        # operator / chaos CLI) holds every worker at each epoch start
+        # until the whole gang — including a relaunched member — arrives.
+        rejoin = maybe_rejoin_gate()
+        if rejoin is not None:
+            callbacks.append(rejoin)
         # Same env-armed pattern for telemetry (tpu_dist.observe): an
         # observe dir in $TPU_DIST_OBSERVE_DIR — set by the Supervisor for
         # chaos workers, or by a shell — attaches the Telemetry callback.
