@@ -234,7 +234,8 @@ def _run_step_bench_body(config, dataset_name, kind, shape, global_batch,
         n_exec_warm, n_exec = warmup, steps
 
     def one_exec(state, i):
-        loss, p, s, o, m, acc = train_fn(*state, xb, yb, keys[i % len(keys)])
+        loss, p, s, o, m, acc, _health = train_fn(*state, xb, yb,
+                                                  keys[i % len(keys)])
         return loss, (p, s, o, m, acc)
 
     # XLA:CPU in-process partition collectives run their rendezvous on the

@@ -86,11 +86,11 @@ def precision_and_split(batch=256, policy: str | None = None):
         # returned state back in instead of reusing stale references.
         out = fn(*st, xb, yb, key)
         jax.device_get(out[0])
-        st = out[1:]
+        st = out[1:6]
         t0 = time.perf_counter()
         for _ in range(n):
             out = fn(*st, xb, yb, key)
-            st = out[1:]
+            st = out[1:6]
         # loss fetch, not block_until_ready: the tunnel's block has been
         # observed returning before device work completes (bench.py r4)
         jax.device_get(out[0])

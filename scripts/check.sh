@@ -65,6 +65,24 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
        exit 1; }
 rm -rf "$elastic_dir"
 
+echo "== integrity-smoke: anomaly-detect, SDC-audit, rollback-and-replay =="
+# The training-integrity acceptance demo from README.md "Training
+# integrity": poison the step-5 batch to a NaN loss AND flip one mantissa
+# bit on one replica's parameter copy at step 9. The in-step health vector
+# must catch the NaN, the cross-replica SDC audit must catch the bitflip
+# (naming leaf + replica), and BOTH must recover by in-process
+# rollback-and-replay. Gates inside the CLI: a plan whose faults never
+# fire fails (anti-vacuity), >= 1 integrity_rollback event is required,
+# any supervisor gang restart fails the run, and the replayed run must
+# reach EXACT loss parity with the uninterrupted baseline.
+integrity_dir=$(mktemp -d /tmp/tpu-dist-integrity.XXXXXX)
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
+  --plan nan_loss@step5,bitflip@step9 \
+  --workdir "$integrity_dir" >/dev/null \
+  || { echo "check.sh: integrity smoke chaos run failed (see $integrity_dir)" >&2
+       exit 1; }
+rm -rf "$integrity_dir"
+
 echo "== observe-smoke: telemetry overhead bench + series validation =="
 # Off/on/off runs of the demo workload on one compiled step; writes
 # BENCH_OBSERVE.json and fails when telemetry costs more than 5% steps/s.

@@ -1,0 +1,341 @@
+"""tpu_dist.training.integrity tests: the exit-code registry's collision
+guard, the in-step health vector's zero-cost contract (no new compiled
+programs, no per-step blocking D2H — one-behind lazy fetch), in-process
+rollback-and-replay under injected semantic faults with exact loss parity,
+the rollback budget's escalation to IntegrityAbort, and the cross-replica
+SDC audit on 8 virtual devices (bitflip on one replica → the audit names
+leaf + replica, restore comes back bit-identical).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.resilience import FAULT_PLAN_ENV, FaultPlan, read_events
+from tpu_dist.resilience.events import EVENT_LOG_ENV
+from tpu_dist.resilience.faults import (EXIT_CODES, EXIT_FAULT_KILL,
+                                        EXIT_INTEGRITY,
+                                        EXIT_PEER_UNAVAILABLE,
+                                        EXIT_PREEMPTED, _PROTOCOL_EXITS,
+                                        classify_exit_code)
+from tpu_dist.training import integrity
+from tpu_dist.training.integrity import (IntegrityAbort, IntegrityConfig,
+                                         IntegrityGuard)
+
+from tests.multidevice_harness import run_with_devices
+
+
+class TestExitRegistry:
+    """The centralized exit-code registry in faults.py: every protocol code
+    in one table, collision-proof by construction."""
+
+    def test_no_code_collisions(self):
+        codes = [c for c, _ in _PROTOCOL_EXITS]
+        assert len(EXIT_CODES) == len(_PROTOCOL_EXITS), (
+            "two protocol exits share a code — the dict silently dropped "
+            f"one: {_PROTOCOL_EXITS}")
+        assert len(set(codes)) == len(codes)
+        names = [n for _, n in _PROTOCOL_EXITS]
+        assert len(set(names)) == len(names)
+        # 0 is 'clean' by special-case, never a protocol entry; and none of
+        # the protocol codes may collide with generic-crash 1.
+        assert 0 not in EXIT_CODES and 1 not in EXIT_CODES
+
+    def test_registry_contents(self):
+        assert EXIT_CODES[EXIT_FAULT_KILL] == "fault_kill"
+        assert EXIT_CODES[EXIT_PEER_UNAVAILABLE] == "peer_unavailable"
+        assert EXIT_CODES[EXIT_PREEMPTED] == "preempted"
+        assert EXIT_CODES[EXIT_INTEGRITY] == "integrity_abort"
+
+    def test_classify_exit_code(self):
+        assert classify_exit_code(0) == "clean"
+        assert classify_exit_code(EXIT_INTEGRITY) == "integrity_abort"
+        assert classify_exit_code(1) == "crash"
+        assert classify_exit_code(-15) == "signal_15"
+
+    def test_supervisor_delegates(self):
+        from tpu_dist.resilience.supervisor import classify_exit
+
+        assert classify_exit(None) == "crash"  # still running / unknown
+        for code, name in _PROTOCOL_EXITS:
+            assert classify_exit(code) == name
+
+
+class TestFaultGrammar:
+    def test_new_kinds_parse_with_aliases(self):
+        plan = FaultPlan.parse("nan-loss@step5, grad-spike@step2,"
+                               "bit-flip@step9:rank3, corrupt-batch@step1")
+        kinds = [f.kind for f in plan.faults]
+        assert kinds == ["nan_loss", "grad_spike", "bitflip", "corrupt_batch"]
+        assert plan.faults[2].rank == 3
+        assert FaultPlan.parse(plan.dumps()) == plan  # JSON roundtrip
+
+    def test_bitflip_rank_armed_in_single_process(self, monkeypatch):
+        from tpu_dist.resilience.injector import maybe_injector_from_env
+
+        # rank names the LOCAL replica in single-process runs — the fault
+        # must arm on process 0 instead of being dropped as rank 3's.
+        monkeypatch.setenv(FAULT_PLAN_ENV, "bitflip@step9:rank3")
+        inj = maybe_injector_from_env(steps_per_epoch=4, rank=0, attempt=0)
+        assert inj is not None and inj.faults[0].kind == "bitflip"
+
+
+class TestHealthVector:
+    def test_health_summary_clean_and_poisoned(self):
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((3,))}
+        grads = {"w": jnp.full((3,), 2.0)}
+        new = {"w": jnp.full((3,), 0.5)}
+        h = np.asarray(integrity.health_summary(
+            jnp.float32(1.0), grads, params, new))
+        assert h[0] == 0.0
+        assert h[1] == pytest.approx(12.0)     # 3 * 2²
+        assert h[2] == pytest.approx(0.75)     # 3 * 0.5²
+        h_bad = np.asarray(integrity.health_summary(
+            jnp.float32(np.nan), grads, params, new))
+        assert h_bad[0] >= 1.0
+
+    def test_reduce_window_health(self):
+        import jax.numpy as jnp
+
+        stack = jnp.asarray([[0.0, 1.0, 0.1],
+                             [2.0, 9.0, 0.2],
+                             [1.0, 3.0, 0.3]])
+        folded = np.asarray(integrity.reduce_window_health(stack))
+        # Counts sum, norms take the window max.
+        assert folded.tolist() == pytest.approx([3.0, 9.0, 0.3])
+
+    def test_one_behind_lazy_fetch(self):
+        """The guard must never block on the CURRENT execution's health —
+        it reads the previous one (whose copy has been in flight for a full
+        step) and only flush() drains the tail."""
+
+        class Probe:
+            def __init__(self):
+                self.async_started = False
+                self.read = False
+
+            def copy_to_host_async(self):
+                self.async_started = True
+
+            def __array__(self, dtype=None, copy=None):
+                self.read = True
+                return np.asarray([0.0, 1.0, 0.1], dtype=dtype)
+
+        guard = IntegrityGuard(IntegrityConfig())
+        p1, p2 = Probe(), Probe()
+        guard.on_execution(0, 1, p1, None)
+        assert p1.async_started and not p1.read
+        guard.on_execution(1, 1, p2, None)
+        assert p1.read and p2.async_started and not p2.read
+        guard.flush()
+        assert p2.read
+        guard.flush()  # idempotent — nothing pending
+
+    def test_spike_detection_relative_to_ema(self):
+        guard = IntegrityGuard(IntegrityConfig(spike_factor=10.0,
+                                               warmup_steps=2,
+                                               rollback_budget=99))
+        for step in range(4):  # establish EMA around gnorm=1
+            guard._judge(step, 1, np.asarray([0.0, 1.0, 0.1]))
+        with pytest.raises(integrity.RollbackAndReplay) as exc:
+            guard._judge(4, 1, np.asarray([0.0, 400.0, 0.1]))  # gnorm 20
+        assert exc.value.kind == "grad_spike"
+        # The spiked value must never have entered the EMA.
+        assert guard._ema == pytest.approx(1.0)
+
+    def test_no_new_compiled_programs_when_armed(self, tmp_path,
+                                                 monkeypatch):
+        """ISSUE gate: arming the guard adds no compiled-program cache
+        entries — the health vector rides the ONE train-step program."""
+        monkeypatch.setenv(integrity.INTEGRITY_ENV, "1")
+        m = _small_model()
+        x, y = _data()
+        ds = td.data.Dataset.from_tensor_slices((x, y)).batch(16)
+        m.fit(ds, epochs=2, steps_per_epoch=4, verbose=0,
+              checkpoint_dir=str(tmp_path / "ckpt"))
+        assert m._trainer._train_step._cache_size() == 1
+
+
+def _small_model():
+    m = td.Sequential([td.models.Dense(8, activation="relu"),
+                       td.models.Dense(4)], input_shape=(4,))
+    m.compile(loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+              optimizer=td.ops.SGD(learning_rate=0.1))
+    return m
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(64, 4).astype(np.float32)
+    y = rng.randint(0, 4, size=(64,)).astype(np.int32)
+    return x, y
+
+
+class TestRollbackAndReplay:
+    def _fit(self, tmp_path, monkeypatch, *, plan=None, budget="3",
+             epochs=3):
+        tmp_path.mkdir(parents=True, exist_ok=True)
+        if plan:
+            monkeypatch.setenv(FAULT_PLAN_ENV, plan)
+        else:
+            monkeypatch.delenv(FAULT_PLAN_ENV, raising=False)
+        monkeypatch.setenv(integrity.INTEGRITY_ENV, "1")
+        monkeypatch.setenv(integrity.BUDGET_ENV, budget)
+        monkeypatch.setenv(EVENT_LOG_ENV, str(tmp_path / "events.jsonl"))
+        m = _small_model()
+        x, y = _data()
+        # Cardinality == steps_per_epoch: each epoch is exactly one pass,
+        # so a rolled-back epoch replays the identical batch sequence.
+        ds = td.data.Dataset.from_tensor_slices((x, y)).batch(16)
+        h = m.fit(ds, epochs=epochs, steps_per_epoch=4, verbose=0,
+                  checkpoint_dir=str(tmp_path / "ckpt"))
+        return [float(v) for v in h.history["loss"]]
+
+    def test_nan_loss_rolls_back_and_matches_clean_run(self, tmp_path,
+                                                       monkeypatch):
+        clean = self._fit(tmp_path / "clean", monkeypatch)
+        chaos = self._fit(tmp_path / "chaos", monkeypatch,
+                          plan="nan_loss@step5")
+        events = read_events(tmp_path / "chaos" / "events.jsonl")
+        kinds = [e.get("event") for e in events]
+        assert "integrity_anomaly" in kinds
+        assert "integrity_rollback" in kinds
+        rb = next(e for e in events if e["event"] == "integrity_rollback")
+        assert rb["restored_step"] == 0 and rb["next_epoch"] == 1
+        # Exact replay: the poisoned batch was consumed by the injector's
+        # count, the restore is bit-faithful, the RNG keys are epoch-derived
+        # — so the final losses agree EXACTLY, not approximately.
+        assert chaos[-1] == clean[-1]
+
+    def test_budget_exhaustion_raises_integrity_abort(self, tmp_path,
+                                                      monkeypatch):
+        with pytest.raises(IntegrityAbort):
+            self._fit(tmp_path, monkeypatch, plan="nan_loss@step5:x5",
+                      budget="1")
+        events = read_events(tmp_path / "events.jsonl")
+        kinds = [e.get("event") for e in events]
+        assert "integrity_budget_exhausted" in kinds
+
+    def test_abort_maps_to_exit_integrity(self):
+        import signal
+
+        from tpu_dist.resilience import entrypoints
+
+        def boom():
+            raise IntegrityAbort("synthetic")
+
+        # run_entry arms the process-wide SIGTERM seam; restore it so later
+        # in-process fits don't grow a PreemptionDrain callback.
+        prev_handler = signal.getsignal(signal.SIGTERM)
+        prev_armed = entrypoints._PREEMPT_ARMED
+        try:
+            assert entrypoints.run_entry(boom) == EXIT_INTEGRITY
+        finally:
+            signal.signal(signal.SIGTERM, prev_handler)
+            entrypoints._PREEMPT_ARMED = prev_armed
+
+
+class TestBatchSeam:
+    def test_install_returns_previous_and_fire_is_identity(self):
+        x, y = object(), object()
+        assert integrity.fire_batch_hook(0, 1, x, y) == (x, y)
+
+        calls = []
+
+        def hook(gstep, k, xx, yy):
+            calls.append((gstep, k))
+            return xx, yy
+
+        prev = integrity.install_batch_fault_hook(hook)
+        try:
+            assert prev is None
+            integrity.fire_batch_hook(7, 2, x, y)
+            assert calls == [(7, 2)]
+        finally:
+            integrity.install_batch_fault_hook(prev)
+        assert integrity._BATCH_FAULT_HOOK is None
+
+
+class TestSDCAudit:
+    def test_audit_skipped_on_model_parallel_mesh(self):
+        class FakeStrategy:
+            model_parallel = True
+            pipeline_parallel = False
+            expert_parallel = False
+
+        guard = IntegrityGuard(IntegrityConfig(audit_every_n=1))
+        guard.bind(FakeStrategy())
+        assert guard.audit({"w": np.ones(3)}, gstep=4) is True  # no-op skip
+
+    def test_bitflip_detected_and_restore_bit_identical(self, tmp_path):
+        """8 virtual devices: flip one mantissa bit on ONE replica's copy
+        of one parameter — the audit must name the leaf and the replica,
+        and restoring the published checkpoint must bring the parameters
+        back bit-identical to the pre-flip state."""
+        body = f"""
+import numpy as np
+
+import tpu_dist as td
+from tpu_dist.training import checkpoint, integrity
+
+strategy = td.MirroredStrategy()
+with strategy.scope():
+    m = td.Sequential([td.models.Dense(8, activation="relu"),
+                       td.models.Dense(4)], input_shape=(4,))
+    m.compile(loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+              optimizer=td.ops.SGD(learning_rate=0.1))
+from tpu_dist.training.trainer import Trainer
+m._trainer = Trainer(m)
+m._trainer.ensure_variables()
+v = m._trainer.variables
+checkpoint.save({str(tmp_path)!r}, m, step=0)
+before = [np.array(l) for l in jax.tree_util.tree_leaves(v["params"])]
+
+guard = integrity.IntegrityGuard(
+    integrity.IntegrityConfig(audit_every_n=2)).bind(strategy)
+assert guard.audit(v["params"], gstep=2) is True  # clean replicas agree
+
+info = integrity.flip_param_bit(v, replica=3)
+kind = culprits = None
+try:
+    guard.audit(v["params"], gstep=4)
+    emit({{"error": "audit missed the flipped bit"}})
+    raise SystemExit(0)
+except integrity.RollbackAndReplay as rb:
+    kind = rb.kind
+    culprits = rb.detail["culprits"]
+
+restored_step = checkpoint.restore_model({str(tmp_path)!r}, m)
+after = [np.array(l)
+         for l in jax.tree_util.tree_leaves(m._trainer.variables["params"])]
+bit_identical = all(a.tobytes() == b.tobytes()
+                    for a, b in zip(before, after))
+emit({{"kind": kind, "culprits": culprits, "flipped": info,
+      "restored_step": restored_step, "bit_identical": bit_identical}})
+"""
+        result = run_with_devices(body, 8)
+        assert "error" not in result, result
+        assert result["kind"] == "sdc"
+        assert result["bit_identical"] is True
+        assert result["restored_step"] == 0
+        (culprit,) = result["culprits"]
+        assert culprit["replica"] == 3
+        assert culprit["leaf"] == result["flipped"]["leaf"]
+
+
+class TestRollbackPlanEscalation:
+    def test_second_hit_at_same_step_goes_strictly_older(self):
+        guard = IntegrityGuard(IntegrityConfig(rollback_budget=99))
+        rb1 = integrity.RollbackAndReplay("nan_loss", 5)
+        assert guard.rollback_plan(rb1) is None  # newest published step
+        guard.note_rollback(rb1, restored=2)
+        rb2 = integrity.RollbackAndReplay("nan_loss", 5)
+        assert guard.rollback_plan(rb2) == 2     # replay didn't get past 5
+        guard.note_rollback(rb2, restored=1)
+        rb3 = integrity.RollbackAndReplay("nan_loss", 9)
+        assert guard.rollback_plan(rb3) is None  # progress was made

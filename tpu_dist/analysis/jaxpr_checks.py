@@ -582,6 +582,62 @@ def _trace_serve_decode():
         params, cache, tokens, lengths)
 
 
+def _trace_integrity_health_step():
+    """The trainer step WITH the in-step health vector — same program the
+    plain train_step entry traces (health_summary is always folded in), but
+    pinned separately so the integrity contract is explicit: arming the
+    guard must add zero collectives and zero comm bytes to the hot loop
+    (all three health scalars reduce values the step already computed)."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.models import Dense, Sequential
+    from tpu_dist.training.trainer import Trainer
+
+    model = Sequential([Dense(4)], input_shape=(4,), name="shardcheck_probe")
+    model.compile(optimizer="sgd", loss="mse")
+    trainer = Trainer(model)
+    step = trainer._pure_step()
+    trainer.ensure_variables()
+    state = trainer.train_state()
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 4), np.float32)
+    rng = jax.random.PRNGKey(0)
+
+    def health_only(*args):
+        return step(*args)[-1]
+
+    return jax.make_jaxpr(health_only)(*state, x, y, rng)
+
+
+def _trace_integrity_audit_checksum():
+    """The SDC audit's per-replica checksum program
+    (training/integrity.py: ``build_audit_checksum``). Pins that the audit
+    is collective-FREE — each device checksums its own replica copy and the
+    comparison happens on host through the collectives seam — so its
+    baselined comm payload is exactly 0 bytes and it can never deadlock
+    against the training step's collectives."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.models import Dense, Sequential
+    from tpu_dist.parallel.strategy import MirroredStrategy
+    from tpu_dist.training.integrity import build_audit_checksum
+    from tpu_dist.training.trainer import Trainer
+
+    strategy = MirroredStrategy()
+    with strategy.scope():
+        model = Sequential([Dense(4)], input_shape=(4,),
+                           name="shardcheck_probe")
+        model.compile(optimizer="sgd", loss="mse")
+        trainer = Trainer(model)
+        trainer.ensure_variables()
+        leaves = jax.tree_util.tree_leaves(trainer.variables["params"])
+        key = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        fn = build_audit_checksum(strategy.mesh, key)
+        return jax.make_jaxpr(fn)(*leaves)
+
+
 ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
@@ -594,6 +650,8 @@ ENTRY_POINTS = {
     "training.checkpoint.snapshot_copy": _trace_checkpoint_snapshot,
     "serve.prefill_step": _trace_serve_prefill,
     "serve.decode_step": _trace_serve_decode,
+    "training.integrity.health_step": _trace_integrity_health_step,
+    "training.integrity.audit_checksum": _trace_integrity_audit_checksum,
 }
 
 #: Argument positions each entry point's production caller donates

@@ -41,6 +41,11 @@ from tpu_dist.resilience.supervisor import (BackoffPolicy, Supervisor)
 
 _RESULT_PREFIX = "RESULT:"
 
+#: Fault kinds recovered IN-PROCESS by the training-integrity guard
+#: (rollback-and-replay) rather than by a supervisor gang restart.
+INTEGRITY_KINDS = frozenset(
+    {"nan_loss", "grad_spike", "bitflip", "corrupt_batch"})
+
 
 def parse_result_line(text: str) -> Optional[dict]:
     """The LAST ``RESULT:{...}`` line in ``text`` — a restarted worker's log
@@ -67,7 +72,8 @@ def _clean_env(extra: dict) -> dict:
     env = {k: v for k, v in os.environ.items()
            if k not in (FAULT_PLAN_ENV, events.EVENT_LOG_ENV,
                         events.ATTEMPT_ENV, CHECKPOINT_DIR_ENV,
-                        OBSERVE_DIR_ENV)}
+                        OBSERVE_DIR_ENV)
+           and not k.startswith("TPU_DIST_INTEGRITY")}
     env.update(extra)
     return env
 
@@ -166,6 +172,22 @@ def main(argv: Optional[list] = None) -> int:
     # v1 broadcast.
     demo_env = ({"TPU_DIST_DEMO_STRATEGY": "mirrored",
                  "TPU_DIST_DEMO_SHARDED": "1"} if reshape else {})
+    # Integrity fault plans arm the in-fit guard in BOTH runs (the baseline
+    # proves an armed guard changes nothing on a clean run); bitflip
+    # additionally needs a real multi-device mesh — the SDC audit compares
+    # replica copies — plus the periodic audit switched on.
+    integrity_faults = [f for f in plan.faults
+                        if f.kind in INTEGRITY_KINDS]
+    if integrity_faults:
+        demo_env.update({"TPU_DIST_INTEGRITY": "1",
+                         "TPU_DIST_INTEGRITY_BUDGET": "3"})
+        if any(f.kind == "bitflip" for f in integrity_faults):
+            demo_env.update({
+                "TPU_DIST_INTEGRITY_AUDIT_N": "2",
+                "TPU_DIST_DEMO_STRATEGY": "mirrored",
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            })
 
     baseline = None
     if not args.no_baseline:
@@ -276,6 +298,33 @@ def main(argv: Optional[list] = None) -> int:
             ok = False
             report["failure"] = ("--reshape given but no reshape_restore "
                                  "happened — vacuous reshape run")
+    # Integrity gates: the fault must have triggered an ACTUAL in-process
+    # rollback-and-replay (else the run is vacuous), and recovery must NOT
+    # have leaned on a supervisor gang restart — the whole point of the
+    # guard is recovering without one.
+    if integrity_faults:
+        rollbacks = events.read_events(event_path, "integrity_rollback")
+        anomalies = events.read_events(event_path, "integrity_anomaly")
+        sdc = events.read_events(event_path, "integrity_sdc")
+        report["integrity"] = {
+            "anomalies": [{k: r.get(k) for k in ("kind", "step", "window")}
+                          for r in anomalies],
+            "rollbacks": [{k: r.get(k)
+                           for k in ("kind", "step", "restored_step",
+                                     "next_epoch")} for r in rollbacks],
+            "sdc_detections": [{k: r.get(k) for k in ("step", "culprits")}
+                               for r in sdc],
+        }
+        if not rollbacks:
+            ok = False
+            report["failure"] = ("integrity plan but no rollback-and-replay "
+                                 "happened — vacuous integrity run")
+        elif sup_report.restarts != 0:
+            ok = False
+            report["failure"] = (
+                f"integrity recovery leaned on a gang restart "
+                f"(restarts={sup_report.restarts}) instead of in-process "
+                f"rollback-and-replay")
     if baseline is not None:
         report["baseline_final_loss"] = baseline.get("final_loss")
         if (report["final_loss"] is not None

@@ -66,7 +66,8 @@ import time
 from typing import Callable, Optional
 
 from tpu_dist.resilience import events
-from tpu_dist.resilience.faults import EXIT_PEER_UNAVAILABLE, EXIT_PREEMPTED
+from tpu_dist.resilience.faults import (EXIT_INTEGRITY,
+                                        EXIT_PEER_UNAVAILABLE, EXIT_PREEMPTED)
 
 CHECKPOINT_DIR_ENV = "TPU_DIST_CHECKPOINT_DIR"
 ENTRY_ENV = "TPU_DIST_ENTRY"
@@ -232,6 +233,7 @@ def run_entry(fn: Callable[[], Optional[dict]]) -> int:
     hand-off to the restarted attempt.
     """
     from tpu_dist.cluster.liveness import PeerUnavailableError
+    from tpu_dist.training.integrity import IntegrityAbort
 
     install_sigterm_handler()
     try:
@@ -241,6 +243,16 @@ def run_entry(fn: Callable[[], Optional[dict]]) -> int:
         print(f"tpu_dist.resilience: giving up on dead peer: {exc}",
               file=sys.stderr, flush=True)
         return EXIT_PEER_UNAVAILABLE
+    except IntegrityAbort as exc:
+        # Rollback-and-replay did not converge: a restart would restore the
+        # same checkpoints and replay into the same wall. Exit with the
+        # dedicated code so the Supervisor classifies ``integrity_abort``
+        # and does NOT burn its restart budget.
+        events.maybe_log("integrity_abort", error=str(exc))
+        print(f"tpu_dist.resilience: integrity rollback budget exhausted: "
+              f"{exc}; exiting {EXIT_INTEGRITY} (integrity_abort)",
+              file=sys.stderr, flush=True)
+        return EXIT_INTEGRITY
     except Exception as exc:  # surfaced via exit code; supervisor restarts
         events.maybe_log("worker_error", error=f"{type(exc).__name__}: {exc}")
         import traceback

@@ -14,6 +14,10 @@ grammar used on the CLI::
     delay-collective@step3:0.5s    # stall host-level collectives 0.5 s
     hang-collective@step4:rank0    # stall them until the attempt deadline
     slow-input@step2:0.25s:x4      # slow the input pipeline for 4 steps
+    nan_loss@step5                 # poison the step-5 batch with NaN
+    grad_spike@step5               # scale the step-5 batch into a grad spike
+    bitflip@step9:rank1            # flip one param bit on replica/rank 1
+    corrupt_batch@step5            # garbage the step-5 batch (finite, huge)
 
 Multiple specs join with commas. Determinism is the design center: a fault
 fires at exactly one (rank, attempt, step/epoch) coordinate, so a chaos run
@@ -52,6 +56,21 @@ Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
     coordinate (``@epochN`` for ModelCheckpoint's per-epoch saves).
 ``slow_input``
     Sleep at host batch boundaries — a straggling input pipeline.
+``nan_loss`` / ``grad_spike`` / ``corrupt_batch``
+    SEMANTIC faults: corrupt the target step's batch through the trainer's
+    batch seam (:func:`tpu_dist.training.integrity.install_batch_fault_hook`)
+    so the *training math* goes wrong while every process stays alive —
+    ``nan_loss`` poisons the batch with NaN, ``grad_spike`` scales it into a
+    gradient explosion, ``corrupt_batch`` replaces it with finite garbage.
+    Detected by the in-step health vector
+    (:mod:`tpu_dist.training.integrity`), recovered by rollback-and-replay —
+    no process exit, no gang restart.
+``bitflip``
+    Silent data corruption: flip one mantissa bit of one parameter leaf on
+    one replica (``:rankR`` = the replica/rank index; in single-process
+    multi-device runs it names the local replica). Nothing crashes and the
+    loss stays plausible — only the cross-replica SDC audit's checksum
+    compare can see it.
 """
 
 from __future__ import annotations
@@ -65,7 +84,8 @@ from typing import Optional, Sequence
 #: Canonical fault kinds. CLI aliases (kill-worker, ckpt-fail, ...) normalize
 #: onto these names.
 KINDS = ("kill", "preempt", "delay_collective", "hang_collective",
-         "checkpoint_fail", "kill_during_save", "slow_input")
+         "checkpoint_fail", "kill_during_save", "slow_input",
+         "nan_loss", "grad_spike", "bitflip", "corrupt_batch")
 
 _ALIASES = {
     "kill-worker": "kill",
@@ -81,6 +101,10 @@ _ALIASES = {
     "kill-during-save": "kill_during_save",
     "ckpt-kill": "kill_during_save",
     "slow-input": "slow_input",
+    "nan-loss": "nan_loss",
+    "grad-spike": "grad_spike",
+    "bit-flip": "bitflip",
+    "corrupt-batch": "corrupt_batch",
 }
 
 #: Environment variable a worker reads its plan from (set by the CLI /
@@ -102,6 +126,50 @@ EXIT_PEER_UNAVAILABLE = 17
 #: different size); it is merely a *clean* restart, distinguishable from
 #: ``fault_kill``/``signal_N`` in ``Supervisor.classify_exit``.
 EXIT_PREEMPTED = 19
+
+#: Exit code of a worker whose training-integrity guard exhausted its
+#: rollback budget — repeated semantic anomalies (NaN loss, grad spikes,
+#: replica SDC) that rollback-and-replay could not clear. Distinct from
+#: ``fault_kill``/``preempted``: restarting the gang will NOT help (the
+#: anomaly is in the data/hardware, not the process), so the supervisor
+#: classifies it ``integrity_abort`` and operators triage instead of
+#: burning restart budget.
+EXIT_INTEGRITY = 41
+
+#: Central protocol-exit registry: every NONZERO exit code the resilience
+#: layer assigns a meaning to, with the classification name
+#: ``Supervisor.classify_exit`` reports. 0 ("ok"), negative codes
+#: ("signal_N") and everything unlisted ("crash") are handled by
+#: :func:`classify_exit_code`; they are not protocol codes. Kept as one
+#: literal tuple so a collision (two meanings, one code) is a single-file
+#: diff — guarded by a tier-1 test.
+_PROTOCOL_EXITS = (
+    (EXIT_PEER_UNAVAILABLE, "peer_unavailable"),
+    (EXIT_PREEMPTED, "preempted"),
+    (EXIT_INTEGRITY, "integrity_abort"),
+    (EXIT_FAULT_KILL, "fault_kill"),
+)
+
+#: code -> classification name, derived from :data:`_PROTOCOL_EXITS`.
+EXIT_CODES = dict(_PROTOCOL_EXITS)
+
+
+def classify_exit_code(code: int) -> str:
+    """Classify a worker exit code against the protocol registry.
+
+    ``0`` -> ``"clean"``; a registered protocol code -> its name; a negative
+    code -> ``"signal_N"`` (killed by signal N, the subprocess convention);
+    anything else -> ``"crash"``.
+    """
+    if code == 0:
+        return "clean"
+    name = EXIT_CODES.get(code)
+    if name is not None:
+        return name
+    if code < 0:
+        return f"signal_{-code}"
+    return "crash"
+
 
 #: "hang" is implemented as a bounded very-long delay: long enough that the
 #: supervisor's per-attempt deadline is what ends it, short enough that an

@@ -17,6 +17,13 @@ reach:
   seam runs on the background writer thread (``os._exit`` kills the whole
   process regardless of thread), making this the deterministic mid-async-
   save preemption.
+* :func:`tpu_dist.training.integrity.install_batch_fault_hook` for the
+  SEMANTIC faults ``nan_loss`` / ``grad_spike`` / ``corrupt_batch`` — the
+  target step's batch is poisoned right before dispatch, so the fault is
+  indistinguishable (to the trainer) from bad data or numerics. ``bitflip``
+  rides ``on_batch_end`` instead: it corrupts one replica's copy of a
+  parameter via :func:`tpu_dist.training.integrity.flip_param_bit` — silent
+  data corruption only the cross-replica SDC audit can see.
 
 Step accounting: ``on_batch_end(step, logs)`` fires once per compiled
 execution with the in-epoch step index; the injector tracks the GLOBAL step
@@ -44,6 +51,15 @@ from tpu_dist.training.callbacks import Callback
 logger = logging.getLogger("tpu_dist.resilience")
 
 
+def integrity_mod():
+    """Lazy import of :mod:`tpu_dist.training.integrity` — the injector is
+    imported by plan-parsing tests before jax is configured, so training
+    modules load only when an integrity fault is actually armed."""
+    from tpu_dist.training import integrity
+
+    return integrity
+
+
 class FaultInjector(Callback):
     """Arms a process's slice of a FaultPlan for one fit() run."""
 
@@ -60,6 +76,7 @@ class FaultInjector(Callback):
         self._global_step = 0
         self._prev_collective_hook = None
         self._prev_write_hook = None
+        self._prev_batch_hook = None
         self._installed = False
 
     # -- event plumbing ------------------------------------------------------
@@ -87,6 +104,10 @@ class FaultInjector(Callback):
 
             self._prev_write_hook = checkpoint.install_write_fault_hook(
                 self._write_hook)
+        if any(f.kind in integrity_mod().BATCH_FAULT_KINDS
+               for f in self.faults):
+            self._prev_batch_hook = integrity_mod().install_batch_fault_hook(
+                self._batch_hook)
         self._installed = True
         for f in self.faults:
             self._log("fault_armed", kind=f.kind, step=f.step, epoch=f.epoch,
@@ -108,6 +129,9 @@ class FaultInjector(Callback):
             from tpu_dist.training import checkpoint
 
             checkpoint.install_write_fault_hook(self._prev_write_hook)
+        if any(f.kind in integrity_mod().BATCH_FAULT_KINDS
+               for f in self.faults):
+            integrity_mod().install_batch_fault_hook(self._prev_batch_hook)
 
     # -- firing --------------------------------------------------------------
 
@@ -142,6 +166,23 @@ class FaultInjector(Callback):
                 self._log("fault_fired", kind=f.kind, step=gstep,
                           seconds=f.seconds)
                 time.sleep(f.seconds)
+            elif f.kind == "bitflip":
+                # Silent data corruption: flip one mantissa bit of one
+                # replica's copy of the first parameter leaf. Nothing in
+                # the step will notice — only the cross-replica SDC audit
+                # can. The flipped state is consumed by the NEXT dispatch.
+                self._remaining[i] -= 1
+                trainer = getattr(self.model, "_trainer", None)
+                if trainer is None or trainer.variables is None:
+                    self._log("fault_skipped", kind="bitflip", step=gstep,
+                              reason="no live trainer variables")
+                    continue
+                info = integrity_mod().flip_param_bit(
+                    trainer.variables, replica=f.rank)
+                self._log("fault_fired", kind="bitflip", step=gstep, **info)
+                logger.warning("fault injection: flipped bit %d of %s on "
+                               "replica %d at step %d", info["bit"],
+                               info["leaf"], info["replica"], gstep)
 
     def _fire_kill(self, i: int, f: FaultSpec, *, at: str) -> None:
         self._remaining[i] -= 1
@@ -234,6 +275,43 @@ class FaultInjector(Callback):
         if self._prev_write_hook is not None:
             self._prev_write_hook(stage_dir, step)
 
+    def _batch_hook(self, first_gstep: int, k: int, x, y):
+        """Poison the batch of a due semantic fault (pre-dispatch seam).
+
+        Fires when the execution window ``[first_gstep, first_gstep + k)``
+        reaches the fault's step (same ``>=`` semantics as ``due_at_step``,
+        so multi-step windows cannot jump past a target); the count is
+        consumed, so a post-rollback replay of the same window trains on
+        the CLEAN batch — that is what makes exact loss parity possible.
+        """
+        import jax.numpy as jnp
+
+        for i, f in enumerate(self.faults):
+            if (f.kind not in integrity_mod().BATCH_FAULT_KINDS
+                    or self._remaining[i] <= 0 or f.step is None
+                    or f.step >= first_gstep + k):
+                continue
+            self._remaining[i] -= 1
+            self._log("fault_fired", kind=f.kind, step=f.step,
+                      window_start=first_gstep, window=k)
+            logger.warning("fault injection: %s poisoning batch window "
+                           "[%d, %d)", f.kind, first_gstep, first_gstep + k)
+            if f.kind == "nan_loss":
+                scale = jnp.asarray(float("nan"), x.dtype)
+            elif f.kind == "grad_spike":
+                scale = jnp.asarray(1e6, x.dtype)
+            else:  # corrupt_batch: wildly out-of-distribution features
+                scale = jnp.asarray(-1e7, x.dtype)
+            if k > 1 and f.step - first_gstep < x.shape[0]:
+                # Stacked multi-step window: poison only the target step's
+                # slice so the window's other steps stay faithful.
+                x = x.at[f.step - first_gstep].multiply(scale)
+            else:
+                x = x * scale
+        if self._prev_batch_hook is not None:
+            return self._prev_batch_hook(first_gstep, k, x, y)
+        return x, y
+
 
 def _truncate_stage(stage_dir) -> None:
     """Cut every staged .npz short — the footprint of a writer that died
@@ -266,6 +344,15 @@ def maybe_injector_from_env(*, steps_per_epoch: int,
     if attempt is None:
         attempt = events.current_attempt()
     mine = plan.for_process(rank, attempt)
+    import jax
+
+    if jax.process_count() == 1:
+        # Single-process multi-device runs: a bitflip's rank names the LOCAL
+        # replica (device) to corrupt, not a process — arm it here even when
+        # rank != 0 instead of dropping it as another process's fault.
+        mine += [f for f in plan.faults
+                 if f.kind == "bitflip" and f not in mine
+                 and (f.attempt is None or attempt == f.attempt)]
     if not mine:
         return None
     logger.info("fault plan armed for rank %d attempt %d: %d fault(s)",

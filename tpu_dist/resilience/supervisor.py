@@ -41,9 +41,7 @@ import time
 from typing import Optional, Sequence
 
 from tpu_dist.resilience import events
-from tpu_dist.resilience.faults import (EXIT_FAULT_KILL,
-                                        EXIT_PEER_UNAVAILABLE,
-                                        EXIT_PREEMPTED)
+from tpu_dist.resilience.faults import EXIT_INTEGRITY, EXIT_PREEMPTED
 
 logger = logging.getLogger("tpu_dist.resilience")
 
@@ -157,17 +155,14 @@ def _free_port() -> int:
 
 
 def classify_exit(code: Optional[int]) -> str:
-    if code == 0:
-        return "clean"
-    if code == EXIT_FAULT_KILL:
-        return "fault_kill"
-    if code == EXIT_PEER_UNAVAILABLE:
-        return "peer_unavailable"
-    if code == EXIT_PREEMPTED:
-        return "preempted"
-    if code is not None and code < 0:
-        return f"signal_{-code}"
-    return "crash"
+    """Name a worker's exit for reports. Delegates to the central protocol
+    registry in :mod:`tpu_dist.resilience.faults` (one source of truth for
+    0/17/19/41/43), keeping only the process-never-exited case here."""
+    if code is None:
+        return "crash"
+    from tpu_dist.resilience.faults import classify_exit_code
+
+    return classify_exit_code(code)
 
 
 class Supervisor:
@@ -462,6 +457,16 @@ class Supervisor:
                 break
             if t_first_failure is None:
                 t_first_failure = time.monotonic()
+            if any(c == EXIT_INTEGRITY for c in outcome.exit_codes
+                   if c is not None):
+                # The worker already exhausted its in-process rollback
+                # budget; a gang restart restores the same checkpoints and
+                # replays into the same wall. Stop and surface for triage.
+                logger.error("supervisor: worker reported integrity_abort — "
+                             "restarting cannot help; stopping")
+                self._log("integrity_abort_stop", attempt=attempt,
+                          exit_codes=outcome.exit_codes)
+                break
             if attempt >= self.max_restarts:
                 logger.error("supervisor: restart budget (%d) exhausted",
                              self.max_restarts)

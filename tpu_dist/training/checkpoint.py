@@ -852,19 +852,29 @@ def is_complete(directory: str | os.PathLike, step: int) -> bool:
     return validate_step_dir(_step_dir(pathlib.Path(directory), step)) is None
 
 
-def latest_complete_step(directory: str | os.PathLike) -> Optional[int]:
+def latest_complete_step(directory: str | os.PathLike, *,
+                         before: Optional[int] = None) -> Optional[int]:
     """The newest step that passes :func:`validate_step_dir` — the resume
     anchor. The pointer file is a hint, not an authority: a fault between
     publish and pointer update (or a corrupt published step) must cost at
     most one checkpoint interval, never the whole run. Unpublished async
     stages (``.stage-N`` dirs, temp dirs) never match the ``ckpt-`` step
     pattern, so a save that died in flight is invisible here by
-    construction."""
+    construction.
+
+    ``before`` restricts the search to steps strictly earlier than the given
+    step — the integrity guard's rollback escalation: when a restore of step
+    N did not clear an anomaly (the corruption predates it), the next
+    candidate is the newest complete step ``before=N``."""
     directory = pathlib.Path(directory)
     pointed = latest_step(directory)
+    if before is not None and pointed is not None and pointed >= before:
+        pointed = None
     if pointed is not None and is_complete(directory, pointed):
         return pointed
     for step in reversed(all_steps(directory)):
+        if before is not None and step >= before:
+            continue
         if step == pointed:
             continue  # already rejected above
         reason = validate_step_dir(_step_dir(directory, step))

@@ -289,7 +289,16 @@ class Trainer:
             # final batch's sample, and accumulating on device keeps the hot
             # loop free of host syncs.
             new_acc = (loss_acc[0] + loss, loss_acc[1] + 1.0)
-            return loss, new_params, new_state, new_opt, new_metrics, new_acc
+            # In-step integrity health vector (tpu_dist.training.integrity):
+            # f32[3] from values this step already computed — a few fused
+            # scalar reductions, one tiny fresh (non-donated) output, read
+            # one execution behind by the guard. Always present so an armed
+            # guard reuses the SAME compiled program as an unarmed fit.
+            from tpu_dist.training.integrity import health_summary
+
+            health = health_summary(loss, grads, params, new_params)
+            return (loss, new_params, new_state, new_opt, new_metrics,
+                    new_acc, health)
 
         return step
 
@@ -304,7 +313,7 @@ class Trainer:
         p_sh = self.strategy.variable_shardings(v["params"], v["params"])
         o_sh = self.strategy.variable_shardings(v["params"], v["opt"])
         return (None, p_sh, rep_like(v["state"]),
-                o_sh, rep_like(v["metrics"]), rep_like(acc))
+                o_sh, rep_like(v["metrics"]), rep_like(acc), rep)
 
     def _build_train_step(self):
         return jax.jit(
@@ -328,17 +337,19 @@ class Trainer:
 
         def one(carry, xs):
             x, y, rng = xs
-            loss, *new_carry = step(*carry, x, y, rng)
-            return tuple(new_carry), loss
+            loss, *new_carry, health = step(*carry, x, y, rng)
+            return tuple(new_carry), (loss, health)
 
         def multi(params, state, opt_state, metric_states, loss_acc,
                   xs_stack, ys_stack, rngs):
-            carry, losses = jax.lax.scan(
+            from tpu_dist.training.integrity import reduce_window_health
+
+            carry, (losses, healths) = jax.lax.scan(
                 one, (params, state, opt_state, metric_states, loss_acc),
                 (xs_stack, ys_stack, rngs))
             params, state, opt_state, metric_states, loss_acc = carry
             return (losses.mean(), params, state, opt_state, metric_states,
-                    loss_acc)
+                    loss_acc, reduce_window_health(healths))
 
         return jax.jit(
             multi,
@@ -354,8 +365,11 @@ class Trainer:
         1, returns the jitted single step::
 
             fn(params, state, opt, metrics, loss_acc, x, y, rng)
-              -> (loss, params, state, opt, metrics, loss_acc)
+              -> (loss, params, state, opt, metrics, loss_acc, health)
 
+        ``health`` is the in-step integrity vector (``f32[3]``, see
+        :func:`tpu_dist.training.integrity.health_summary`) — custom loops
+        thread ``out[1:6]`` as the next call's state and may ignore it.
         With K > 1, returns the scanned multi-step, whose ``x``/``y``/``rng``
         carry a leading K axis (stack K batches; see ``jnp_stack_keys``) and
         whose loss is the K-mean. Both donate their variable arguments —
@@ -592,6 +606,16 @@ class Trainer:
                         "validation_steps is required for validation datasets "
                         "of unknown cardinality")
 
+        # Env-armed training-integrity guard (tpu_dist.training.integrity):
+        # in-step anomaly detection + periodic cross-replica SDC audit +
+        # rollback-and-replay, riding the hot loop directly (NOT a callback
+        # — a batch-hook callback would force per-step blocking loss reads).
+        from tpu_dist.training import integrity as integrity_lib
+
+        guard = integrity_lib.maybe_guard_from_env()
+        if guard is not None:
+            guard.bind(self.strategy, checkpoint_dir=checkpoint_dir)
+
         history = History()
         cbs = CallbackList([history, *callbacks], model=self.model)
         chief = bootstrap.is_chief()
@@ -607,9 +631,22 @@ class Trainer:
                else contextlib.nullcontext())
         try:
             with ctx:
-                self._run_epochs(dist, cbs, initial_epoch, epochs,
-                                 steps_per_epoch, show, root_key,
-                                 val_dist=val_dist, val_steps=val_steps)
+                start_epoch = initial_epoch
+                while True:
+                    try:
+                        self._run_epochs(dist, cbs, start_epoch, epochs,
+                                         steps_per_epoch, show, root_key,
+                                         val_dist=val_dist,
+                                         val_steps=val_steps, guard=guard)
+                        break
+                    except integrity_lib.RollbackAndReplay as rb:
+                        # Confirmed anomaly: restore the last published
+                        # checkpoint and replay from that epoch boundary.
+                        # Budget enforcement lives in the guard — it raises
+                        # IntegrityAbort (escapes fit) when replay is not
+                        # converging.
+                        start_epoch = self._integrity_rollback(
+                            rb, guard, checkpoint_dir, seed)
         except StopTraining as e:
             logger.info("training stopped early: %s", e)
         finally:
@@ -618,10 +655,52 @@ class Trainer:
             cbs.on_train_end()
         return history
 
+    def _integrity_rollback(self, rb, guard, checkpoint_dir, seed) -> int:
+        """Rollback-and-replay: restore the newest published checkpoint
+        (strictly older than the last restore when replay re-hit the same
+        anomaly), reset the data iterator to the epoch boundary, and return
+        the epoch to re-enter the loop at. With no published checkpoint the
+        run re-initializes from the seed and replays from epoch 0 — exact
+        for the epoch-keyed RNG + per-epoch-pass datasets of the demo
+        paths."""
+        from tpu_dist.observe import metrics as metrics_lib
+        from tpu_dist.resilience import events
+        from tpu_dist.training import checkpoint as ckpt_lib
+
+        restored = None
+        if checkpoint_dir is not None:
+            step = ckpt_lib.latest_complete_step(
+                checkpoint_dir, before=guard.rollback_plan(rb))
+            if step is not None:
+                restored = ckpt_lib.restore_model(checkpoint_dir, self.model,
+                                                  step=step, trainer=self)
+        if restored is None:
+            self.variables = None
+            self.ensure_variables(seed)
+            next_epoch = 0
+        else:
+            next_epoch = restored + 1
+        # Fresh iterator: replay re-reads the epoch's batches from the top —
+        # identical to what a gang-restarted attempt would see (persistent
+        # iterators are recreated per pass when cardinality matches).
+        self._iterator = None
+        guard.note_rollback(rb, restored)
+        metrics_lib.inc("integrity.rollbacks")
+        events.maybe_log("integrity_rollback", kind=rb.kind, step=rb.gstep,
+                         restored_step=restored, next_epoch=next_epoch,
+                         attempt=events.current_attempt())
+        logger.warning(
+            "integrity rollback: anomaly %r at global step %d; restored "
+            "checkpoint step %s, replaying from epoch %d",
+            rb.kind, rb.gstep, restored, next_epoch)
+        return next_epoch
+
     def _run_epochs(self, dist, cbs, initial_epoch, epochs, steps_per_epoch,
-                    show, root_key, val_dist=None, val_steps=None):
+                    show, root_key, val_dist=None, val_steps=None,
+                    guard=None):
         from tpu_dist.data.device import DeviceDataset
         from tpu_dist.observe.telemetry import active_step_timer
+        from tpu_dist.training.integrity import fire_batch_hook
 
         device_ds = isinstance(dist, DeviceDataset)
         monitor = getattr(self.strategy, "liveness_monitor", None)
@@ -669,13 +748,33 @@ class Trainer:
             executions = 0
             while step_i < steps_per_epoch:
                 kk = min(k, steps_per_epoch - step_i)
+                gstep0 = epoch * steps_per_epoch + step_i
+                if guard is not None and guard.should_skip(gstep0, kk):
+                    # Quarantined window (integrity guard, opt-in): pull the
+                    # batches so the iterator stays aligned, but skip the
+                    # dispatch — replaying a data-poisoned window would just
+                    # re-trigger the same rollback.
+                    if device_ds:
+                        dist.next_batch() if kk == 1 else dist.next_stack(kk)
+                    elif k > 1:
+                        for _ in range(kk):
+                            self._next_batch(dist, host=True)
+                    else:
+                        self._next_batch(dist)
+                    from tpu_dist.resilience import events as _events
+
+                    _events.maybe_log("integrity_quarantine_skip",
+                                      step=gstep0, window=kk)
+                    step_i += kk
+                    executions += 1
+                    continue
                 # Step-phase timing (tpu_dist.observe): data-wait ends at
                 # t_fetch, dispatch at the compiled call's return, device
                 # time is the block_until_ready below. perf_counter calls
                 # only when a Telemetry span is active.
                 t_exec0 = time.perf_counter() if timer is not None else 0.0
                 t_fetch = t_exec0
-                with profiler.step_annotation(epoch * steps_per_epoch + step_i):
+                with profiler.step_annotation(gstep0):
                     if kk == 1:
                         if device_ds:
                             xb, yb = dist.next_batch()
@@ -687,21 +786,23 @@ class Trainer:
                             xb, yb = self.strategy.distribute_batch(hb)
                         else:
                             xb, yb = self._next_batch(dist)
+                        xb, yb = fire_batch_hook(gstep0, 1, xb, yb)
                         rng = key_chunks[executions]
                         if timer is not None:
                             t_fetch = time.perf_counter()
                         (loss, v["params"], v["state"], v["opt"], v["metrics"],
-                         loss_acc) = self._train_step(
+                         loss_acc, health) = self._train_step(
                             v["params"], v["state"], v["opt"], v["metrics"],
                             loss_acc, xb, yb, rng)
                     elif device_ds:
                         # Device-resident path: batches gathered ON device
                         # (index transfer only), one scanned dispatch.
                         xb, yb = dist.next_stack(kk)
+                        xb, yb = fire_batch_hook(gstep0, kk, xb, yb)
                         if timer is not None:
                             t_fetch = time.perf_counter()
                         (loss, v["params"], v["state"], v["opt"],
-                         v["metrics"], loss_acc) = self._multi_step(
+                         v["metrics"], loss_acc, health) = self._multi_step(
                             v["params"], v["state"], v["opt"],
                             v["metrics"], loss_acc, xb, yb,
                             key_chunks[executions])
@@ -720,8 +821,10 @@ class Trainer:
                             ys = np.stack([b[1] for b in batches])
                             xb, yb = self.strategy.distribute_batch_stack(
                                 (xs, ys))
+                            xb, yb = fire_batch_hook(gstep0, kk, xb, yb)
                             (loss, v["params"], v["state"], v["opt"],
-                             v["metrics"], loss_acc) = self._multi_step(
+                             v["metrics"], loss_acc,
+                             health) = self._multi_step(
                                 v["params"], v["state"], v["opt"],
                                 v["metrics"], loss_acc, xb, yb,
                                 key_chunks[executions])
@@ -731,13 +834,21 @@ class Trainer:
                             # per-step instead of crashing.
                             for j, hb in enumerate(batches):
                                 xb, yb = self.strategy.distribute_batch(hb)
+                                xb, yb = fire_batch_hook(gstep0 + j, 1,
+                                                         xb, yb)
                                 (loss, v["params"], v["state"], v["opt"],
-                                 v["metrics"], loss_acc) = self._train_step(
+                                 v["metrics"], loss_acc,
+                                 health) = self._train_step(
                                     v["params"], v["state"], v["opt"],
                                     v["metrics"], loss_acc, xb, yb,
                                     key_chunks[executions][j])
                 step_i += kk
                 executions += 1
+                if guard is not None:
+                    # One-behind health judgement + periodic SDC audit: the
+                    # new vector's host copy starts now (non-blocking), the
+                    # previous execution's — already in flight — is judged.
+                    guard.on_execution(gstep0, kk, health, v["params"])
                 if timer is not None:
                     # The blocking wait IS the device-time measurement; it
                     # also satisfies the bounded-dispatch requirement.
@@ -756,6 +867,11 @@ class Trainer:
                     # Keras steps_per_execution semantics: batch hooks fire
                     # once per execution, logs carry the execution's loss.
                     cbs.on_batch_end(step_i - 1, {"loss": loss_val})
+            if guard is not None:
+                # Judge the final in-flight health vector BEFORE epoch-end
+                # callbacks run: a poisoned last step must trigger rollback
+                # here, not after ModelCheckpoint has published the epoch.
+                guard.flush()
             # ZERO host syncs on the epoch boundary: the loss mean and each
             # metric result are queued as device ops right behind the last
             # step's dispatch, a single batched non-blocking device→host
