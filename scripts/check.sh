@@ -121,6 +121,21 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/serve_bench.py \
   || { echo "check.sh: serve bench gates failed (see BENCH_SERVE.json)" >&2
        exit 1; }
 
+echo "== serve-chaos-smoke: crash mid-decode, journal replay, token parity =="
+# Kills the serve worker with engine-crash@req2, lets the ServeSupervisor
+# restart it against the durable request journal, and gates on: the fault
+# actually fired (non-vacuity), >= 1 restart, journal replay happened, and
+# the replayed greedy token streams are bit-identical to an uninterrupted
+# baseline. Writes SERVE_CHAOS.json.
+chaos_dir=$(mktemp -d)
+timeout -k 10 300 env JAX_PLATFORMS=cpu python -m tpu_dist.serve --chaos \
+  --plan engine-crash@req2 --requests 6 --max-batch 4 --max-len 32 \
+  --max-new 8 --vocab 32 --d-model 16 --depth 1 --num-heads 2 \
+  --workdir "$chaos_dir" --report SERVE_CHAOS.json >/dev/null \
+  || { echo "check.sh: serve chaos gates failed (see SERVE_CHAOS.json)" >&2
+       exit 1; }
+rm -rf "$chaos_dir"
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

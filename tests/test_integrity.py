@@ -19,8 +19,8 @@ from tpu_dist.resilience.events import EVENT_LOG_ENV
 from tpu_dist.resilience.faults import (EXIT_CODES, EXIT_FAULT_KILL,
                                         EXIT_INTEGRITY,
                                         EXIT_PEER_UNAVAILABLE,
-                                        EXIT_PREEMPTED, _PROTOCOL_EXITS,
-                                        classify_exit_code)
+                                        EXIT_PREEMPTED, EXIT_SERVE_ABORT,
+                                        _PROTOCOL_EXITS, classify_exit_code)
 from tpu_dist.training import integrity
 from tpu_dist.training.integrity import (IntegrityAbort, IntegrityConfig,
                                          IntegrityGuard)
@@ -49,6 +49,7 @@ class TestExitRegistry:
         assert EXIT_CODES[EXIT_PEER_UNAVAILABLE] == "peer_unavailable"
         assert EXIT_CODES[EXIT_PREEMPTED] == "preempted"
         assert EXIT_CODES[EXIT_INTEGRITY] == "integrity_abort"
+        assert EXIT_CODES[EXIT_SERVE_ABORT] == "serve_abort"
 
     def test_classify_exit_code(self):
         assert classify_exit_code(0) == "clean"

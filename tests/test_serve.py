@@ -255,6 +255,74 @@ class TestScheduler:
         assert s.record_token(r2, 4, now=4.0)
         assert r2.finish_reason == "length"
 
+    # -- edge cases the journal replay leans on ------------------------------
+
+    def test_slot_reuse_immediately_after_deadline_eviction(self):
+        # Replay re-admits recovered requests right after recovery evicts
+        # stale ones; the freed slot must be reusable the same round.
+        s = Scheduler(2)
+        doomed = s.submit(self._req(deadline_s=1.0), now=0.0)
+        keeper = s.submit(self._req(deadline_s=None), now=0.0)
+        s.admit()
+        assert doomed.slot == 0 and keeper.slot == 1
+        (evict,) = s.evict_deadline(now=5.0)
+        assert evict[0] is doomed and evict[1] == (0, 1)  # keeper moved down
+        assert keeper.slot == 0 and s.num_active == 1
+        fresh = s.submit(self._req(), now=5.0)
+        (admitted,) = s.admit()
+        assert admitted is fresh and fresh.slot == 1  # the freed slot
+        assert s.slots[0] is keeper and s.slots[1] is fresh
+
+    def test_queued_deadline_expiry_races_admission(self):
+        # A queued request whose deadline has already passed must expire,
+        # never occupy a slot — even when a slot frees in the same round.
+        s = Scheduler(1)
+        hog = s.submit(self._req(deadline_s=None, max_new_tokens=1),
+                       now=0.0)
+        stale = s.submit(self._req(deadline_s=1.0), now=0.0)
+        live = s.submit(self._req(deadline_s=50.0), now=0.0)
+        s.admit()
+        s.record_token(hog, 7, now=2.0)
+        s.finish(hog, now=2.0)  # slot frees at now=2.0 — stale is expired
+        evicted = s.evict_deadline(now=2.0)
+        assert [(r, sw) for r, sw in evicted] == [(stale, None)]
+        assert stale.status == "evicted"
+        assert stale.finish_reason == "deadline" and stale.slot == -1
+        (admitted,) = s.admit()
+        assert admitted is live  # FIFO skips the expired one entirely
+
+    def test_multi_free_compaction_applies_swaps_in_slot_order(self):
+        # Several slots freeing in one round: releases run highest slot
+        # first, so each swap moves a slot the remaining releases no
+        # longer reference. The survivor set must come out compact.
+        s = Scheduler(4)
+        reqs = [s.submit(self._req(), now=0.0) for _ in range(4)]
+        s.admit()
+        done = [reqs[0], reqs[2]]  # free slots 0 and 2 together
+        swaps = [s.finish(r, now=1.0)
+                 for r in sorted(done, key=lambda r: r.slot, reverse=True)]
+        # Slot 2 freed first: last slot (3) moves into it; then slot 0
+        # freed: new last slot (2, now holding reqs[3]) moves down.
+        assert swaps == [(2, 3), (0, 2)]
+        assert s.num_active == 2
+        assert s.slots[0] is reqs[3] and s.slots[1] is reqs[1]
+        assert {r.slot for r in s.active()} == {0, 1}
+        assert reqs[0].slot == -1 and reqs[2].slot == -1
+
+    def test_bounded_queue_and_rid_pinning(self):
+        s = Scheduler(1, max_queue=1)
+        s.submit(self._req(), now=0.0)
+        assert s.full()
+        with pytest.raises(RuntimeError):
+            s.submit(self._req(), now=0.0)
+        # Journal-recovered requests pin their original rid; the counter
+        # jumps past it so fresh submissions never collide.
+        s2 = Scheduler(2)
+        pinned = s2.submit(self._req(), now=0.0, rid=7)
+        fresh = s2.submit(self._req(), now=0.0)
+        assert pinned.rid == 7 and fresh.rid == 8
+        assert s2.reserve_rid() == 9
+
 
 class _FakeClock:
     def __init__(self):

@@ -1,0 +1,452 @@
+"""Serve-path fault tolerance: durable request journal + crash recovery
+(token-identical greedy continuation, pinned against an uninterrupted
+run), overload shedding (queue bound / projected TTFT / deadline
+feasibility / retry budget), the decode-stall watchdog, the serve fault
+kinds in the FaultPlan grammar, the ServeFaultInjector seams, the
+request-storm virtual-clock gate, and the ``--chaos`` end-to-end run
+(supervised crash → restart → journal replay → bit-identical streams).
+
+Timing-free where possible: deadlines and storm latencies run on the
+injected virtual clock; the only real-time test is the watchdog (bounded
+at fractions of a second).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpu_dist.models.transformer import build_transformer_lm
+from tpu_dist.resilience.faults import (EXIT_SERVE_ABORT, FaultPlan,
+                                        FaultSpec, classify_exit_code)
+from tpu_dist.resilience.injector import (ServeFaultInjector,
+                                          maybe_serve_injector_from_env)
+from tpu_dist.serve import journal as journal_lib
+from tpu_dist.serve.chaos import VirtualClock
+from tpu_dist.serve.engine import ServeEngine
+from tpu_dist.serve.journal import RequestJournal
+from tpu_dist.serve.scheduler import DONE, QUEUED, SHED, Request, Scheduler
+
+VOCAB = 32
+
+
+def _lm(seq_len=32, d_model=16, depth=2, num_heads=2):
+    model = build_transformer_lm(VOCAB, seq_len, d_model=d_model,
+                                 depth=depth, num_heads=num_heads)
+    model.init(0)
+    return model
+
+
+def _workload(n, *, seed=7, max_new=10):
+    rng = np.random.default_rng(seed)
+    return [{"prompt": rng.integers(0, VOCAB,
+                                    size=int(rng.integers(2, 8))).tolist(),
+             "max_new_tokens": int(rng.integers(3, max_new + 1))}
+            for _ in range(n)]
+
+
+class TestJournal:
+    def test_roundtrip_and_pending_order(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync=False)
+        reqs = [Request(prompt=[1, 2], max_new_tokens=4, rid=0),
+                Request(prompt=[3], max_new_tokens=2, eos_id=9, rid=1),
+                Request(prompt=[4, 5], max_new_tokens=3, rid=2)]
+        for r in reqs:
+            j.record_submit(r)
+        j.record_token(0, 11)
+        j.record_token(0, 12)
+        reqs[1].status = DONE
+        reqs[1].finish_reason = "eos"
+        j.record_finish(reqs[1])
+        j.close()
+
+        state = journal_lib.load(j.path)
+        assert state.known_rids == {0, 1, 2}
+        assert state.next_rid == 3
+        assert state.requests[0].tokens == [11, 12]
+        assert state.requests[1].finished
+        assert state.requests[1].finish_reason == "eos"
+        active, queued = state.pending()
+        assert [r.rid for r in active] == [0]   # has tokens, unfinished
+        assert [r.rid for r in queued] == [2]   # submitted, never started
+
+    def test_flush_is_batched(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync=False)
+        j.record_submit(Request(prompt=[1], rid=0))
+        j.record_token(0, 5)
+        assert not j.path.exists()  # buffered: nothing durable yet
+        assert j.flush() == 2
+        assert len(j.path.read_text().splitlines()) == 2
+        assert j.flush() == 0  # buffer drained
+
+    def test_torn_trailing_line_skipped(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync=False)
+        j.record_submit(Request(prompt=[1, 2], rid=0))
+        j.record_token(0, 7)
+        j.flush()
+        with open(j.path, "a") as fh:
+            fh.write('{"rec": "token", "rid": 0, "t"')  # writer died here
+        state = journal_lib.load(j.path)
+        assert state.requests[0].tokens == [7]
+
+    def test_missing_file_is_empty_state(self, tmp_path):
+        state = journal_lib.load(tmp_path / "nope.jsonl")
+        assert not state.requests and state.next_rid == 0
+
+    def test_replay_marker_counts_active_replays(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync=False)
+        j.record_submit(Request(prompt=[1], rid=0))
+        j.record_submit(Request(prompt=[2], rid=1))
+        j.record_token(0, 3)
+        j.record_replay(attempt=1, queued=[1], active=[0], completed=[],
+                        replay_s=0.01)
+        j.record_replay(attempt=2, queued=[1], active=[0], completed=[],
+                        replay_s=0.01)
+        j.close()
+        state = journal_lib.load(j.path)
+        assert state.requests[0].replays == 2
+        assert state.requests[1].replays == 0
+        assert len(state.replay_markers) == 2
+
+    def test_stop_satisfied(self):
+        jr = journal_lib.JournaledRequest(
+            0, prompt=[1], max_new_tokens=3, eos_id=9, deadline_s=None,
+            order=0)
+        jr.tokens = [4, 5]
+        assert not jr.stop_satisfied()
+        jr.tokens = [4, 9]
+        assert jr.stop_satisfied() and jr.implied_finish_reason() == "eos"
+        jr.tokens = [4, 5, 6]
+        jr.eos_id = None
+        assert jr.stop_satisfied() and jr.implied_finish_reason() == "length"
+
+    def test_closed_journal_rejects_records(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync=False)
+        j.close()
+        with pytest.raises(RuntimeError):
+            j.record_token(0, 1)
+
+
+class TestServeFaultGrammar:
+    def test_req_target_parsing(self):
+        plan = FaultPlan.parse("engine-crash@req3")
+        f = plan.faults[0]
+        assert f.kind == "engine_crash" and f.req == 3
+        assert not f.due_at_req(2)
+        assert f.due_at_req(3) and f.due_at_req(4)  # >= semantics
+
+    def test_stall_seconds_modifier(self):
+        f = FaultPlan.parse("decode-stall@req2:5s").faults[0]
+        assert f.kind == "decode_stall" and f.req == 2 and f.seconds == 5.0
+
+    def test_serve_kind_requires_req_target(self):
+        with pytest.raises(ValueError):
+            FaultSpec(kind="engine_crash", step=3)
+        with pytest.raises(ValueError):
+            FaultSpec(kind="kill", req=3)
+
+    def test_json_roundtrip_keeps_req(self):
+        plan = FaultPlan.parse("request-storm@req0")
+        again = FaultPlan.parse(plan.dumps())
+        assert again.faults[0].req == 0
+        assert again.faults[0].kind == "request_storm"
+
+    def test_exit_serve_abort_registered(self):
+        assert classify_exit_code(EXIT_SERVE_ABORT) == "serve_abort"
+
+
+class TestServeFaultInjector:
+    def test_engine_crash_fires_once_at_req_count(self, monkeypatch):
+        exits = []
+        monkeypatch.setattr("tpu_dist.resilience.injector.os._exit",
+                            exits.append)
+        inj = ServeFaultInjector(FaultPlan.parse("engine-crash@req2").faults)
+        inj.on_step_end(0)
+        inj.on_step_end(1)
+        assert not exits
+        inj.on_step_end(2)
+        assert exits == [FaultSpec(kind="engine_crash", req=0).exit_code]
+        inj.on_step_end(3)  # count consumed: no re-fire
+        assert len(exits) == 1
+
+    def test_decode_stall_sleeps_inside_decode_window(self, monkeypatch):
+        naps = []
+        monkeypatch.setattr("tpu_dist.resilience.injector.time.sleep",
+                            naps.append)
+        inj = ServeFaultInjector(
+            FaultPlan.parse("decode-stall@req1:2s").faults)
+        inj.on_decode()
+        assert not naps  # zero requests done: not due yet
+        inj.on_step_end(1)
+        inj.on_decode()
+        assert naps == [2.0]
+        inj.on_decode()
+        assert len(naps) == 1
+
+    def test_env_factory_filters_attempt_and_kind(self, monkeypatch):
+        from tpu_dist.resilience.faults import FAULT_PLAN_ENV
+
+        monkeypatch.setenv(FAULT_PLAN_ENV,
+                           "engine-crash@req1, request-storm@req0")
+        inj = maybe_serve_injector_from_env(attempt=0)
+        # request_storm is a submission-side fault — the injector only
+        # arms the engine-side kinds.
+        assert [f.kind for f in inj.faults] == ["engine_crash"]
+        assert maybe_serve_injector_from_env(attempt=1) is None
+        monkeypatch.delenv(FAULT_PLAN_ENV)
+        assert maybe_serve_injector_from_env(attempt=0) is None
+
+
+class TestCrashRecoveryParity:
+    """The tentpole guarantee: restart + journal replay continues every
+    greedy stream bit-identically to an uninterrupted run."""
+
+    def _serve_uninterrupted(self, model, workload):
+        engine = ServeEngine(model, max_batch=4, max_len=32)
+        reqs = [engine.submit(w["prompt"],
+                              max_new_tokens=w["max_new_tokens"])
+                for w in workload]
+        engine.run_until_idle()
+        return {r.rid: list(r.generated) for r in reqs}
+
+    def test_recovery_streams_match_uninterrupted(self, tmp_path):
+        model = _lm()
+        workload = _workload(8)
+        baseline = self._serve_uninterrupted(model, workload)
+
+        # Crash simulation: serve a few rounds with the journal armed,
+        # then abandon the engine WITHOUT close() — everything since the
+        # last per-step flush is lost, exactly like os._exit.
+        first = ServeEngine(model, max_batch=4, max_len=32,
+                            journal=tmp_path / "j")
+        for w in workload:
+            first.submit(w["prompt"], max_new_tokens=w["max_new_tokens"])
+        for _ in range(3):
+            first.step()
+        first.journal._buf.clear()  # the torn unflushed tail
+        del first
+
+        second = ServeEngine(model, max_batch=4, max_len=32,
+                             journal=tmp_path / "j")
+        assert second.last_replay is not None
+        assert second.known_rids == set(range(8))
+        # Idempotent resubmission: the worker loop skips every known rid.
+        second.run_until_idle()
+        second.close()
+
+        state = journal_lib.load(tmp_path / "j" / journal_lib.JOURNAL_NAME)
+        assert len(state.replay_markers) == 1
+        for rid, want in baseline.items():
+            jr = state.requests[rid]
+            assert jr.finished, f"request {rid} never finished after replay"
+            assert jr.tokens == want, (
+                f"request {rid} diverged after recovery: "
+                f"{jr.tokens} != {want}")
+
+    def test_active_requests_resume_midstream(self, tmp_path):
+        model = _lm()
+        engine = ServeEngine(model, max_batch=2, max_len=32,
+                             journal=tmp_path / "j")
+        req = engine.submit([3, 1, 4, 1], max_new_tokens=8)
+        for _ in range(4):
+            engine.step()
+        emitted = list(req.generated)
+        assert 0 < len(emitted) < 8
+        del engine
+
+        revived = ServeEngine(model, max_batch=2, max_len=32,
+                              journal=tmp_path / "j")
+        (again,) = [r for r in revived.scheduler.queue if r.rid == req.rid]
+        assert again.generated == emitted  # re-prefill seed, not a restart
+        revived.run_until_idle()
+        uninterrupted = ServeEngine(model, max_batch=2, max_len=32)
+        assert again.generated == uninterrupted.generate(
+            [3, 1, 4, 1], max_new_tokens=8)
+
+    def test_stop_satisfied_requests_finish_during_replay(self, tmp_path):
+        j = RequestJournal(tmp_path / "j", fsync=False)
+        done = Request(prompt=[1, 2], max_new_tokens=2, rid=0)
+        j.record_submit(done)
+        j.record_token(0, 5)
+        j.record_token(0, 6)  # hits max_new_tokens; finish record lost
+        j.close()
+        model = _lm()
+        engine = ServeEngine(model, max_batch=2, max_len=32,
+                             journal=tmp_path / "j")
+        assert engine.scheduler.idle()  # nothing re-admitted
+        (r,) = engine.finished
+        assert r.rid == 0 and r.status == DONE
+        assert r.finish_reason == "length" and r.generated == [5, 6]
+        assert engine.last_replay["completed"] == [0]
+
+    def test_retry_budget_sheds_poison_pill(self, tmp_path):
+        j = RequestJournal(tmp_path / "j", fsync=False)
+        j.record_submit(Request(prompt=[1, 2], max_new_tokens=8, rid=0))
+        j.record_token(0, 5)
+        for attempt in (1, 2):
+            j.record_replay(attempt=attempt, queued=[], active=[0],
+                            completed=[], replay_s=0.01)
+        j.close()
+        model = _lm()
+        engine = ServeEngine(model, max_batch=2, max_len=32,
+                             journal=tmp_path / "j", retry_budget=2)
+        (r,) = engine.finished
+        assert r.status == SHED and r.shed_cause == "retry_budget"
+        assert engine.scheduler.idle()
+        # ... and the shed is durable: a THIRD restart does not resurrect
+        # the poison pill.
+        engine.close()
+        third = ServeEngine(model, max_batch=2, max_len=32,
+                            journal=tmp_path / "j", retry_budget=2)
+        assert third.scheduler.idle() and not third.finished
+
+
+class TestOverloadShedding:
+    def test_queue_full_sheds_with_cause(self):
+        model = _lm()
+        engine = ServeEngine(model, max_batch=1, max_len=32, max_queue=3)
+        kept = [engine.submit([1, 2], max_new_tokens=4) for _ in range(3)]
+        shed = engine.submit([3, 4], max_new_tokens=4)
+        assert all(r.status == QUEUED for r in kept[1:])
+        assert shed.status == SHED
+        assert shed.finish_reason == "shed"
+        assert shed.shed_cause == "queue_full"
+        assert shed in engine.finished and shed.rid == 3
+        engine.run_until_idle()
+        assert all(r.status == DONE for r in kept)
+
+    def test_projected_ttft_sheds_after_ema_established(self):
+        model = _lm()
+        engine = ServeEngine(model, max_batch=1, max_len=32, max_ttft_s=1.0)
+        engine._step_ema_s = 0.5  # as if decode steps took 500 ms
+        engine.submit([1, 2], max_new_tokens=6)
+        engine.submit([3, 4], max_new_tokens=6)
+        # 12 owed tokens x 0.5 s / 1 lane = 6 s projected >> 1 s bound.
+        shed = engine.submit([5, 6], max_new_tokens=6)
+        assert shed.status == SHED and shed.shed_cause == "projected_ttft"
+
+    def test_unmeetable_deadline_rejected_early(self):
+        model = _lm()
+        engine = ServeEngine(model, max_batch=1, max_len=32)
+        engine._step_ema_s = 0.5
+        shed = engine.submit([1, 2], max_new_tokens=20, deadline_s=1.0)
+        assert shed.status == SHED
+        assert shed.shed_cause == "deadline_unmeetable"
+        ok = engine.submit([1, 2], max_new_tokens=20, deadline_s=60.0)
+        assert ok.status == QUEUED
+
+    def test_no_ema_no_projection_shedding(self):
+        # Before any decode step there is no basis for a TTFT projection;
+        # only the queue bound may shed.
+        model = _lm()
+        engine = ServeEngine(model, max_batch=1, max_len=32, max_ttft_s=0.1)
+        assert engine.submit([1], max_new_tokens=30,
+                             deadline_s=0.5).status == QUEUED
+
+
+class TestDecodeStallWatchdog:
+    class _Stall:
+        def __init__(self, naps):
+            self._naps = list(naps)
+
+        def on_decode(self):
+            if self._naps:
+                time.sleep(self._naps.pop(0))
+
+        def on_step_end(self, done_count):
+            pass
+
+    def test_watchdog_fires_on_stalled_decode(self):
+        tripped = []
+        model = _lm()
+        engine = ServeEngine(model, max_batch=1, max_len=32,
+                             stall_timeout_s=0.15,
+                             stall_action=tripped.append,
+                             fault_injector=self._Stall([0.5]))
+        engine.submit([1, 2, 3], max_new_tokens=3)
+        engine.run_until_idle()
+        assert len(tripped) == 1
+        assert tripped[0]["timeout_s"] == 0.15
+        assert tripped[0]["bucket"] == 1
+
+    def test_watchdog_quiet_on_healthy_steps(self):
+        tripped = []
+        model = _lm()
+        engine = ServeEngine(model, max_batch=1, max_len=32,
+                             stall_timeout_s=30.0,
+                             stall_action=tripped.append)
+        engine.submit([1, 2, 3], max_new_tokens=4)
+        engine.run_until_idle()
+        assert not tripped
+
+
+class TestVirtualClockStorm:
+    def test_shedding_bounds_latency_where_control_blows_it(self):
+        model = _lm()
+        budget = dict(max_new_tokens=6)
+        runs = {}
+        for mode, knobs in (("shed", dict(max_queue=4)), ("control", {})):
+            clock = VirtualClock()
+            engine = ServeEngine(model, max_batch=2, max_len=32,
+                                 clock=clock, virtual_step_s=0.1, **knobs)
+            rng = np.random.default_rng(0)
+            submitted = 0
+            while submitted < 60 or not engine.scheduler.idle():
+                for _ in range(min(10, 60 - submitted)):
+                    engine.submit(
+                        rng.integers(0, VOCAB, size=3).tolist(), **budget)
+                    submitted += 1
+                engine.step()
+            done = [r for r in engine.finished if r.status == DONE]
+            shed = [r for r in engine.finished if r.status == SHED]
+            runs[mode] = (max(r.latency_s for r in done), len(shed))
+        shed_worst, shed_count = runs["shed"]
+        control_worst, control_shed = runs["control"]
+        assert shed_count > 0 and control_shed == 0
+        # Bounded queue: an admitted request waits for at most
+        # max_queue + max_batch requests' worth of decode steps.
+        assert shed_worst < control_worst / 2
+
+    def test_virtual_clock_drives_ema(self):
+        model = _lm()
+        clock = VirtualClock()
+        engine = ServeEngine(model, max_batch=1, max_len=32, clock=clock,
+                             virtual_step_s=0.25)
+        engine.submit([1, 2], max_new_tokens=3)
+        engine.run_until_idle()
+        assert engine._step_ema_s == pytest.approx(0.25)
+
+
+class TestServeSupervisorChaosE2E:
+    """The acceptance gate: engine_crash mid-decode → supervised restart →
+    journal replay → bit-identical final greedy streams, all through the
+    real ``--chaos`` CLI (subprocess workers, shared journal)."""
+
+    def test_engine_crash_chaos_end_to_end(self, tmp_path, capsys):
+        from tpu_dist.serve.cli import main
+
+        report_path = tmp_path / "report.json"
+        rc = main(["--chaos", "--plan", "engine-crash@req2",
+                   "--requests", "6", "--max-batch", "4", "--max-len", "32",
+                   "--vocab", str(VOCAB), "--d-model", "16", "--depth", "1",
+                   "--num-heads", "2", "--max-new", "8",
+                   "--workdir", str(tmp_path / "chaos"),
+                   "--report", str(report_path)])
+        capsys.readouterr()
+        report = json.loads(report_path.read_text())
+        assert rc == 0 and report["ok"], report.get("failure")
+        eng = report["engine"]
+        assert eng["restarts"] >= 1
+        assert any(f["kind"] == "engine_crash" for f in eng["faults_fired"])
+        assert eng["journal_replays"], "recovered without a journal replay"
+        assert eng["token_mismatches"] == []
+        assert eng["parity_ok"] is True
+        assert "fault_kill" in {k for ks in eng["exit_kinds"] for k in ks}
+
+    def test_chaos_requires_serve_fault_plan(self, tmp_path, capsys):
+        from tpu_dist.serve.cli import main
+
+        assert main(["--chaos", "--plan", "kill-worker@step2"]) == 2
+        assert main(["--chaos"]) == 2
+        capsys.readouterr()

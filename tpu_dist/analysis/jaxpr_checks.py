@@ -567,7 +567,10 @@ def _trace_serve_decode():
     slot against the cached K/V. The steady-state serving hot loop: pins
     it collective-free and baselines its comm/HBM so a regression (an
     accidental all-gather of the cache, a cache-sized temporary) gates CI
-    exactly like a training-step regression."""
+    exactly like a training-step regression. The serve-resilience layer
+    (request journal, shedding, stall watchdog) is host-side by design —
+    it must add zero collectives and zero comm bytes here, which this
+    unchanged baseline enforces."""
     import jax
     import jax.numpy as jnp
 

@@ -18,6 +18,9 @@ grammar used on the CLI::
     grad_spike@step5               # scale the step-5 batch into a grad spike
     bitflip@step9:rank1            # flip one param bit on replica/rank 1
     corrupt_batch@step5            # garbage the step-5 batch (finite, huge)
+    engine_crash@req4              # kill the serve engine at the 4th completion
+    decode_stall@req2:2s           # hang a decode step 2 s mid-serve
+    request_storm@req0:x400        # 400-request burst at submission 0
 
 Multiple specs join with commas. Determinism is the design center: a fault
 fires at exactly one (rank, attempt, step/epoch) coordinate, so a chaos run
@@ -71,6 +74,18 @@ Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
     multi-device runs it names the local replica). Nothing crashes and the
     loss stays plausible — only the cross-replica SDC audit's checksum
     compare can see it.
+``engine_crash`` / ``decode_stall`` / ``request_storm``
+    SERVE-path faults, addressed by the request coordinate ``@reqN``
+    instead of a training step (dispatch lives in
+    :class:`~tpu_dist.resilience.injector.ServeFaultInjector`, armed by the
+    serve worker/engine seams). ``engine_crash`` is ``os._exit(exit_code)``
+    at the decode-step boundary once N requests have completed — a mid-
+    decode engine death whose recovery must come from the request journal;
+    ``decode_stall`` sleeps ``:Ss`` seconds inside the decode window so the
+    engine's stall watchdog (not a wedged event loop) must classify the
+    hang as a fault; ``request_storm`` injects ``:xM`` extra burst requests
+    into the load generator at submission index N, the overload that load
+    shedding must absorb.
 """
 
 from __future__ import annotations
@@ -85,7 +100,12 @@ from typing import Optional, Sequence
 #: onto these names.
 KINDS = ("kill", "preempt", "delay_collective", "hang_collective",
          "checkpoint_fail", "kill_during_save", "slow_input",
-         "nan_loss", "grad_spike", "bitflip", "corrupt_batch")
+         "nan_loss", "grad_spike", "bitflip", "corrupt_batch",
+         "engine_crash", "decode_stall", "request_storm")
+
+#: Fault kinds that target the SERVING path; they address the request
+#: coordinate (``@reqN``) instead of a training step/epoch.
+SERVE_KINDS = frozenset({"engine_crash", "decode_stall", "request_storm"})
 
 _ALIASES = {
     "kill-worker": "kill",
@@ -105,6 +125,9 @@ _ALIASES = {
     "grad-spike": "grad_spike",
     "bit-flip": "bitflip",
     "corrupt-batch": "corrupt_batch",
+    "engine-crash": "engine_crash",
+    "decode-stall": "decode_stall",
+    "request-storm": "request_storm",
 }
 
 #: Environment variable a worker reads its plan from (set by the CLI /
@@ -136,6 +159,14 @@ EXIT_PREEMPTED = 19
 #: burning restart budget.
 EXIT_INTEGRITY = 41
 
+#: Exit code of a serve engine that classified its own death — today the
+#: decode-stall watchdog converting a hung decode step into a fault instead
+#: of blocking the serving loop forever. Unlike ``integrity_abort`` this IS
+#: restartable: a wedged device op is cured by a fresh process, so the
+#: ServeSupervisor restarts (within its budget) and the request journal
+#: replays queued/in-flight work.
+EXIT_SERVE_ABORT = 45
+
 #: Central protocol-exit registry: every NONZERO exit code the resilience
 #: layer assigns a meaning to, with the classification name
 #: ``Supervisor.classify_exit`` reports. 0 ("ok"), negative codes
@@ -148,6 +179,7 @@ _PROTOCOL_EXITS = (
     (EXIT_PREEMPTED, "preempted"),
     (EXIT_INTEGRITY, "integrity_abort"),
     (EXIT_FAULT_KILL, "fault_kill"),
+    (EXIT_SERVE_ABORT, "serve_abort"),
 )
 
 #: code -> classification name, derived from :data:`_PROTOCOL_EXITS`.
@@ -176,7 +208,7 @@ def classify_exit_code(code: int) -> str:
 #: unsupervised run eventually unwedges instead of leaking a process forever.
 HANG_SECONDS = 3600.0
 
-_TARGET_RE = re.compile(r"^(step|epoch)(\d+)$")
+_TARGET_RE = re.compile(r"^(step|epoch|req)(\d+)$")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -187,6 +219,7 @@ class FaultSpec:
     kind: str
     step: Optional[int] = None      # global step (epoch * steps_per_epoch + i)
     epoch: Optional[int] = None
+    req: Optional[int] = None       # serve kinds: request coordinate
     rank: int = 0
     attempt: Optional[int] = 0      # None = every restart attempt
     seconds: float = 1.0            # delay/slow kinds
@@ -198,7 +231,16 @@ class FaultSpec:
         if self.kind not in KINDS:
             raise ValueError(
                 f"unknown fault kind {self.kind!r}; valid: {list(KINDS)}")
-        if self.step is None and self.epoch is None:
+        if self.kind in SERVE_KINDS:
+            if self.req is None:
+                raise ValueError(
+                    f"serve fault {self.kind!r} needs a request coordinate "
+                    f"(@reqN), got step={self.step} epoch={self.epoch}")
+        elif self.req is not None:
+            raise ValueError(
+                f"fault {self.kind!r} is not a serve kind; @reqN targets "
+                f"only {sorted(SERVE_KINDS)}")
+        elif self.step is None and self.epoch is None:
             raise ValueError(f"fault {self.kind!r} needs a step or epoch")
         if self.kind == "checkpoint_fail" and self.mode not in (
                 "transient", "truncate"):
@@ -221,6 +263,12 @@ class FaultSpec:
 
     def due_at_epoch(self, epoch: int) -> bool:
         return self.epoch is not None and epoch >= self.epoch
+
+    def due_at_req(self, n: int) -> bool:
+        """Serve kinds: due once the request coordinate (completed count
+        for engine_crash/decode_stall, submission index for request_storm)
+        reaches the target (``>=`` — same no-jump-past semantics as steps)."""
+        return self.req is not None and n >= self.req
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -292,7 +340,8 @@ def _parse_compact(spec: str) -> FaultSpec:
     spec = spec.strip()
     if "@" not in spec:
         raise ValueError(
-            f"bad fault spec {spec!r}: expected kind@stepN or kind@epochN")
+            f"bad fault spec {spec!r}: expected kind@stepN, kind@epochN or "
+            f"kind@reqN")
     head, _, tail = spec.partition("@")
     kind = _ALIASES.get(head.strip(), head.strip())
     if kind not in KINDS:
@@ -301,12 +350,12 @@ def _parse_compact(spec: str) -> FaultSpec:
             f"valid: {sorted(set(KINDS) | set(_ALIASES))}")
     parts = [p.strip() for p in tail.split(":") if p.strip()]
     if not parts:
-        raise ValueError(f"bad fault spec {spec!r}: missing @step/@epoch")
+        raise ValueError(f"bad fault spec {spec!r}: missing @step/@epoch/@req")
     m = _TARGET_RE.match(parts[0])
     if not m:
         raise ValueError(
             f"bad fault target {parts[0]!r} in {spec!r}: "
-            "expected stepN or epochN")
+            "expected stepN, epochN or reqN")
     kwargs: dict = {m.group(1): int(m.group(2))}
     for mod in parts[1:]:
         if mod.startswith("rank") and mod[4:].isdigit():
@@ -338,7 +387,8 @@ def describe(plan: FaultPlan) -> Sequence[str]:
     """Human-readable one-liners, one per fault (CLI/report rendering)."""
     out = []
     for f in plan.faults:
-        where = (f"step {f.step}" if f.step is not None
+        where = (f"req {f.req}" if f.req is not None
+                 else f"step {f.step}" if f.step is not None
                  else f"epoch {f.epoch}")
         when = ("every attempt" if f.attempt is None
                 else f"attempt {f.attempt}")

@@ -194,7 +194,8 @@ class Supervisor:
                  worker_schedule: Optional[Sequence[int]] = None,
                  device_schedule: Optional[Sequence[int]] = None,
                  rejoin_window_s: float = 0.0,
-                 max_rejoins: int = 4):
+                 max_rejoins: int = 4,
+                 no_restart_exits: Sequence[int] = (EXIT_INTEGRITY,)):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         if max_restarts < 0:
@@ -232,6 +233,13 @@ class Supervisor:
         #: rendezvous) instead of condemning the gang.
         self.rejoin_window_s = float(rejoin_window_s)
         self.max_rejoins = int(max_rejoins)
+        #: Exit codes that stop supervision instead of triggering a
+        #: restart: the worker declared its failure non-recoverable (by
+        #: default ``integrity_abort`` — a restart restores the same
+        #: checkpoints and replays into the same wall). Serve supervision
+        #: overrides this: ``serve_abort`` (a wedged decode runtime) IS
+        #: cured by a fresh process.
+        self.no_restart_exits = frozenset(int(c) for c in no_restart_exits)
 
     # -- elastic gang shapes -------------------------------------------------
 
@@ -457,15 +465,19 @@ class Supervisor:
                 break
             if t_first_failure is None:
                 t_first_failure = time.monotonic()
-            if any(c == EXIT_INTEGRITY for c in outcome.exit_codes
-                   if c is not None):
-                # The worker already exhausted its in-process rollback
-                # budget; a gang restart restores the same checkpoints and
-                # replays into the same wall. Stop and surface for triage.
-                logger.error("supervisor: worker reported integrity_abort — "
-                             "restarting cannot help; stopping")
-                self._log("integrity_abort_stop", attempt=attempt,
-                          exit_codes=outcome.exit_codes)
+            fatal = [c for c in outcome.exit_codes
+                     if c is not None and c in self.no_restart_exits]
+            if fatal:
+                # The worker declared this failure non-recoverable (e.g.
+                # integrity_abort: the in-process rollback budget is spent;
+                # a gang restart restores the same checkpoints and replays
+                # into the same wall). Stop and surface for triage.
+                logger.error("supervisor: worker exited %s (%s) — "
+                             "restarting cannot help; stopping",
+                             fatal[0], classify_exit(fatal[0]))
+                self._log("no_restart_stop", attempt=attempt,
+                          exit_codes=outcome.exit_codes,
+                          kinds=[classify_exit(c) for c in fatal])
                 break
             if attempt >= self.max_restarts:
                 logger.error("supervisor: restart budget (%d) exhausted",

@@ -7,14 +7,26 @@ scheduler admits/evicts requests *between* decode steps. Latency SLO
 metrics flow through ``tpu_dist.observe``; the prefill/decode programs
 are shardcheck entry points with cost baselines. ``python -m
 tpu_dist.serve --bench`` runs the seeded load generator.
+
+Serving resilience (README "Serving resilience"): a durable
+``RequestJournal`` makes a ``ServeSupervisor``-restarted engine replay
+queued and in-flight requests with token-identical greedy continuations;
+bounded-queue/projected-TTFT shedding and a decode-stall watchdog keep
+overload and hangs from taking the engine down silently. ``python -m
+tpu_dist.serve --chaos`` runs the gated serve chaos suite.
 """
 
 from tpu_dist.serve.engine import ServeEngine
+from tpu_dist.serve.journal import JournalState, RequestJournal
 from tpu_dist.serve.kv_cache import (DecodePlan, build_plan, decode_step,
                                      init_cache, prefill)
-from tpu_dist.serve.scheduler import Request, Scheduler, default_buckets
+from tpu_dist.serve.scheduler import (DONE, EVICTED, SHED, Request,
+                                      Scheduler, default_buckets)
+from tpu_dist.serve.supervisor import ServeSupervisor
 
 __all__ = [
     "ServeEngine", "DecodePlan", "build_plan", "decode_step", "init_cache",
     "prefill", "Request", "Scheduler", "default_buckets",
+    "RequestJournal", "JournalState", "ServeSupervisor",
+    "DONE", "EVICTED", "SHED",
 ]

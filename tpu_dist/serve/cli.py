@@ -37,7 +37,10 @@ from tpu_dist.observe import metrics
 from tpu_dist.observe.telemetry import OBSERVE_DIR_ENV
 
 
-def _build_engine(args, *, policy: Optional[str] = None):
+def _build_engine(args, *, policy: Optional[str] = None, **engine_kwargs):
+    """Build the demo/bench engine; ``engine_kwargs`` forward the
+    resilience knobs (journal, max_queue, stall watchdog, ...) straight to
+    :class:`~tpu_dist.serve.engine.ServeEngine`."""
     from tpu_dist.models.transformer import build_transformer_lm
     from tpu_dist.serve.engine import ServeEngine
 
@@ -45,14 +48,15 @@ def _build_engine(args, *, policy: Optional[str] = None):
         return ServeEngine.from_saved(
             args.model_dir, max_batch=args.max_batch,
             policy=policy or args.policy, temperature=args.temperature,
-            seed=args.seed)
+            seed=args.seed, **engine_kwargs)
     model = build_transformer_lm(args.vocab, args.max_len,
                                  d_model=args.d_model, depth=args.depth,
                                  num_heads=args.num_heads)
     return ServeEngine(model, max_batch=args.max_batch,
                        max_len=args.max_len,
                        policy=policy or args.policy,
-                       temperature=args.temperature, seed=args.seed)
+                       temperature=args.temperature, seed=args.seed,
+                       **engine_kwargs)
 
 
 def _workload(args) -> list[dict]:
@@ -70,8 +74,16 @@ def _workload(args) -> list[dict]:
 
 
 def _summary(engine, *, wall_s: float) -> dict:
-    done = [r for r in engine.finished if r.status == "done"]
-    evicted = [r for r in engine.finished if r.status == "evicted"]
+    from tpu_dist.serve.scheduler import DONE, EVICTED, SHED
+
+    # Terminal states are mutually exclusive and exhaustive: every
+    # finished request is exactly one of done / evicted / shed (a shed
+    # request never held a slot, an evicted one never completed).
+    done = [r for r in engine.finished if r.status == DONE]
+    evicted = [r for r in engine.finished if r.status == EVICTED]
+    shed = [r for r in engine.finished if r.status == SHED]
+    assert len(done) + len(evicted) + len(shed) == len(engine.finished), \
+        "finished request with a non-terminal status"
     tokens = sum(len(r.generated) for r in engine.finished)
 
     def q(vals, p):
@@ -85,6 +97,7 @@ def _summary(engine, *, wall_s: float) -> dict:
     return {
         "completed": len(done),
         "evicted": len(evicted),
+        "shed": len(shed),
         "tokens_generated": tokens,
         "wall_s": round(wall_s, 4),
         "throughput_tok_s": (round(tokens / wall_s, 2) if wall_s > 0
@@ -195,18 +208,66 @@ def main(argv=None) -> int:
     p.add_argument("--num-heads", type=int, default=4)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    # -- resilience / chaos (README "Serving resilience") -----------------
+    p.add_argument("--worker", action="store_true",
+                   help="supervised serve worker: journal + fault plan "
+                        "from the environment, RESULT line on stdout")
+    p.add_argument("--chaos", action="store_true",
+                   help="serve chaos run: baseline, supervised faults, "
+                        "gated JSON report")
+    p.add_argument("--plan", default=None,
+                   help="fault plan for --chaos (engine_crash@reqN / "
+                        "decode_stall@reqN:Ss / request_storm@reqN)")
+    p.add_argument("--journal-dir", default=None,
+                   help="durable request journal directory (recovery "
+                        "replays an existing journal)")
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="bounded admission queue: shed past this depth")
+    p.add_argument("--max-ttft-s", type=float, default=None,
+                   help="shed when projected TTFT exceeds this bound")
+    p.add_argument("--retry-budget", type=int, default=3,
+                   help="max crash replays before a request is shed")
+    p.add_argument("--stall-timeout-s", type=float, default=None,
+                   help="decode-stall watchdog bound (None = disabled)")
+    p.add_argument("--max-restarts", type=int, default=3)
+    p.add_argument("--deadline", type=float, default=120.0, metavar="S",
+                   help="per-attempt wall-clock deadline for --chaos")
+    p.add_argument("--storm-requests", type=int, default=300)
+    p.add_argument("--storm-burst", type=int, default=25,
+                   help="storm submissions between decode rounds")
+    p.add_argument("--virtual-step-s", type=float, default=0.05,
+                   help="virtual decode-step seconds for the storm gate")
+    p.add_argument("--p99-target-s", type=float, default=None,
+                   help="storm p99 gate (default: BENCH_SERVE.json)")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--report", default=None,
+                   help="also write the chaos JSON report to this path")
     args = p.parse_args(argv)
+
+    if args.worker:
+        from tpu_dist.serve.chaos import run_worker
+
+        return run_worker(args)
+    if args.chaos:
+        from tpu_dist.serve.chaos import run_chaos
+
+        return run_chaos(args)
 
     metrics.get_registry().reset()
     metrics.enable()
     try:
-        engine = _build_engine(args)
+        engine = _build_engine(args, journal=args.journal_dir,
+                               max_queue=args.max_queue,
+                               max_ttft_s=args.max_ttft_s,
+                               retry_budget=args.retry_budget,
+                               stall_timeout_s=args.stall_timeout_s)
         if args.bench:
             summary = run_load(engine, _workload(args),
                                clients=args.clients,
                                arrival_rate=args.arrival_rate,
                                seed=args.seed,
                                deadline_s=args.deadline_s)
+            engine.close()
             mode = ("open-loop" if args.arrival_rate > 0 else "closed-loop")
             report = {
                 "bench": "serve.load",
@@ -220,13 +281,17 @@ def main(argv=None) -> int:
                            "seed": args.seed},
                 **summary,
             }
+            # A run that completed nothing is vacuous — including the
+            # degenerate case where overload protection shed EVERYTHING.
             report["ok"] = report["completed"] > 0
             obs = _export_observe("serve_bench")
             if obs:
                 report["observe_dir"] = obs
             print(json.dumps(report, indent=2))
             if not report["ok"]:
-                print("VACUOUS: no request completed", file=sys.stderr)
+                print(f"VACUOUS: no request completed "
+                      f"({report['shed']} shed, {report['evicted']} "
+                      f"evicted)", file=sys.stderr)
                 return 1
             return 0
 
@@ -239,6 +304,7 @@ def main(argv=None) -> int:
                 for _ in range(min(args.requests, 6))]
         t0 = time.monotonic()
         engine.run_until_idle()
+        engine.close()
         for r in reqs:
             print(f"req {r.rid}: prompt[{len(r.prompt)}] -> "
                   f"{r.generated} ({r.finish_reason}, "

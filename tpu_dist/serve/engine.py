@@ -25,15 +25,26 @@ Every step emits host-side observe metrics (never inside jit —
 shardcheck SC103 guards this): ``serve.request.latency_s`` /
 ``serve.request.ttft_s`` / ``serve.batch.occupancy`` distributions (the
 registry's reservoir quantiles give p50/p95/p99 directly),
-``serve.queue.depth`` gauge, and ``serve.{requests.*,tokens.generated,
-decode.steps,prefills}`` counters. Arm ``$TPU_DIST_OBSERVE_DIR`` (or
-call ``metrics.enable()``) to record; disabled is free.
+``serve.queue.depth`` / ``serve.ready`` gauges, and
+``serve.{requests.*,tokens.generated,decode.steps,prefills}`` counters.
+Arm ``$TPU_DIST_OBSERVE_DIR`` (or call ``metrics.enable()``) to record;
+disabled is free.
+
+Resilience (see ``serve/journal.py`` and README "Serving resilience"):
+an optional durable request journal makes a supervised restart replay
+queued and in-flight requests with token-identical greedy continuations;
+a bounded admission queue + projected-TTFT/deadline feasibility checks
+shed load the engine cannot serve (``finish_reason="shed"``); a decode-
+stall watchdog converts a hung decode step into a classified fault
+(:data:`~tpu_dist.resilience.faults.EXIT_SERVE_ABORT`) instead of
+blocking the serving loop forever.
 """
 
 from __future__ import annotations
 
 import functools
 import logging
+import threading
 import time
 from typing import Optional, Sequence
 
@@ -45,11 +56,35 @@ from tpu_dist.models.model import Sequential
 from tpu_dist.observe import metrics
 from tpu_dist.parallel.strategy import get_strategy
 from tpu_dist.serve import kv_cache
-from tpu_dist.serve.scheduler import DONE, Request, Scheduler
+from tpu_dist.serve import journal as journal_lib
+from tpu_dist.serve.scheduler import DONE, SHED, Request, Scheduler
 
 logger = logging.getLogger(__name__)
 
 _MIN_PROMPT_PAD = 8
+
+#: EMA smoothing for the decode-step wall-time estimate behind the
+#: projected-TTFT admission check.
+_EMA_ALPHA = 0.3
+
+
+def _default_stall_action(info: dict) -> None:
+    """What a production engine does about a hung decode step: classify it
+    as a fault and die with the registered serve exit code — the
+    ServeSupervisor restarts the engine and the journal replays the work.
+    ``os._exit`` on purpose: the main thread is wedged inside the runtime,
+    so no Python-level unwind can run."""
+    import os as _os
+
+    from tpu_dist.resilience import events
+    from tpu_dist.resilience.faults import EXIT_SERVE_ABORT
+
+    logger.error("serve: decode step stalled > %.3fs (bucket %s) — "
+                 "exiting %d (serve_abort) for supervised restart",
+                 info.get("timeout_s", -1.0), info.get("bucket"),
+                 EXIT_SERVE_ABORT)
+    events.maybe_log("serve_stall", **info)
+    _os._exit(EXIT_SERVE_ABORT)
 
 
 def _pad_to_pow2(n: int, *, lo: int = _MIN_PROMPT_PAD, hi: int) -> int:
@@ -74,13 +109,46 @@ class ServeEngine:
       temperature: 0 = greedy argmax; > 0 samples from the tempered
         softmax with a host-side seeded generator (deterministic runs).
       clock: injectable monotonic clock (tests pin deadlines with it).
+      journal: a :class:`~tpu_dist.serve.journal.RequestJournal`, or a
+        directory path to open one in. When the directory already holds a
+        journal, the engine RECOVERS before serving: journaled-but-
+        unfinished requests are re-admitted in arrival order, formerly
+        active ones re-prefilled with ``prompt + tokens_emitted_so_far``
+        (token-identical greedy continuation).
+      max_queue: bounded admission queue — submissions past this depth are
+        shed (``finish_reason="shed"``, cause ``queue_full``).
+      max_ttft_s: shed a submission whose projected time-to-first-token
+        (queue + active work ahead of it, at the EMA decode-step time)
+        exceeds this bound (cause ``projected_ttft``).
+      retry_budget: a journal-replayed request found ACTIVE in more than
+        this many crashes is shed instead of re-admitted (cause
+        ``retry_budget``) — poison-pill protection.
+      stall_timeout_s: decode-stall watchdog — a decode step (dispatch
+        through host materialization) exceeding this wall bound triggers
+        ``stall_action`` (default: exit ``EXIT_SERVE_ABORT`` for a
+        supervised restart). None disables the watchdog (no per-step cost).
+      stall_action: injectable watchdog action (tests record instead of
+        exiting); receives an info dict.
+      fault_injector: serve chaos seam — an object with ``on_decode`` /
+        ``on_step_end`` hooks (see
+        :class:`~tpu_dist.resilience.injector.ServeFaultInjector`).
+      virtual_step_s: when > 0 and ``clock`` has an ``advance`` method,
+        the engine advances the injected clock by this much per decode
+        step — a deterministic stand-in for a production-sized model's
+        step time, used by the request-storm chaos gate so queueing-delay
+        measurements don't depend on host speed.
     """
 
     def __init__(self, model: Sequential, *, max_batch: int = 8,
                  max_len: Optional[int] = None,
                  buckets: Optional[tuple[int, ...]] = None,
                  policy: str = "continuous", temperature: float = 0.0,
-                 seed: int = 0, cache_dtype=jnp.float32, clock=None):
+                 seed: int = 0, cache_dtype=jnp.float32, clock=None,
+                 journal=None, max_queue: Optional[int] = None,
+                 max_ttft_s: Optional[float] = None, retry_budget: int = 3,
+                 stall_timeout_s: Optional[float] = None,
+                 stall_action=None, fault_injector=None,
+                 virtual_step_s: float = 0.0):
         self.model = model
         self.plan = kv_cache.build_plan(model)
         self.max_len = int(max_len or self.plan.max_position)
@@ -112,7 +180,7 @@ class ServeEngine:
             buckets or "pow2")
 
         self.scheduler = Scheduler(self.max_batch, buckets=buckets,
-                                   policy=policy)
+                                   policy=policy, max_queue=max_queue)
         # Host mirrors of per-slot decode state (compacted with the
         # scheduler's slot moves).
         self._tokens = np.zeros(self.max_batch, np.int32)
@@ -127,6 +195,98 @@ class ServeEngine:
         self._donate = donate
         self._swap_fn = jax.jit(kv_cache.swap_slots,
                                 donate_argnums=(0,) if donate else ())
+
+        # -- resilience state --------------------------------------------
+        self.max_ttft_s = None if max_ttft_s is None else float(max_ttft_s)
+        self.retry_budget = int(retry_budget)
+        self.stall_timeout_s = (None if stall_timeout_s is None
+                                else float(stall_timeout_s))
+        self.stall_action = stall_action or _default_stall_action
+        self.fault_injector = fault_injector
+        self.virtual_step_s = float(virtual_step_s)
+        self._step_ema_s: Optional[float] = None
+        self._done_count = 0
+        self._closed = False
+        self.last_replay: Optional[dict] = None
+        self.known_rids: set = set()
+        if journal is None:
+            self.journal: Optional[journal_lib.RequestJournal] = None
+        elif isinstance(journal, journal_lib.RequestJournal):
+            self.journal = journal
+        else:
+            self.journal = journal_lib.RequestJournal(journal)
+        if self.journal is not None:
+            self._recover_from_journal()
+        metrics.set_gauge("serve.ready", 1.0)
+
+    # -- crash recovery -------------------------------------------------------
+
+    def _recover_from_journal(self) -> None:
+        """Replay an existing journal into the scheduler: formerly active
+        requests first (arrival order, re-prefilled with their journaled
+        tokens for a token-identical greedy continuation), then the queued
+        ones; requests whose journaled tokens already satisfy their stop
+        condition finish here; actives past the retry budget are shed."""
+        t0 = time.monotonic()
+        state = journal_lib.load(self.journal.path)
+        self.known_rids = state.known_rids
+        if not state.requests:
+            return
+        active, queued = state.pending()
+        completed, replayed, shed = [], [], []
+        for jr in active + queued:
+            req = Request(prompt=list(jr.prompt),
+                          max_new_tokens=jr.max_new_tokens,
+                          eos_id=jr.eos_id, deadline_s=jr.deadline_s,
+                          generated=list(jr.tokens), replays=jr.replays)
+            if jr.stop_satisfied():
+                # The work survived the crash; only its terminal record
+                # was lost. Finish it now, never re-admit.
+                req.rid = jr.rid
+                req.status = DONE
+                req.finish_reason = jr.implied_finish_reason()
+                self.scheduler._next_rid = max(self.scheduler._next_rid,
+                                               jr.rid + 1)
+                self.finished.append(req)
+                self.journal.record_finish(req)
+                self._done_count += 1
+                metrics.inc("serve.requests.completed")
+                completed.append(jr.rid)
+                continue
+            if jr.tokens and jr.replays + 1 > self.retry_budget:
+                req.rid = jr.rid
+                self.scheduler._next_rid = max(self.scheduler._next_rid,
+                                               jr.rid + 1)
+                self._shed(req, "retry_budget", journaled=True)
+                shed.append(jr.rid)
+                continue
+            # Deadlines re-arm relative to re-submission: the original
+            # submit wall-clock is from a dead process.
+            self.scheduler.submit(req, now=self.clock(), rid=jr.rid)
+            replayed.append(jr.rid)
+        replay_s = time.monotonic() - t0
+        attempt = len(state.replay_markers) + 1
+        self.last_replay = {
+            "attempt": attempt,
+            "active": [r.rid for r in active],
+            "queued": [r.rid for r in queued],
+            "replayed": replayed, "completed": completed, "shed": shed,
+            "replay_s": replay_s,
+        }
+        self.journal.record_replay(
+            attempt=attempt, queued=[r.rid for r in queued],
+            active=[r.rid for r in active], completed=completed,
+            replay_s=replay_s)
+        metrics.observe_value("serve.journal.replay_s", replay_s)
+        from tpu_dist.resilience import events
+        events.maybe_log("serve_replay", attempt=attempt,
+                         replayed=len(replayed), completed=len(completed),
+                         shed=len(shed), replay_s=round(replay_s, 6))
+        logger.info(
+            "serve: journal replay #%d — %d re-admitted (%d were active), "
+            "%d finished from journaled tokens, %d shed, %.3fs",
+            attempt, len(replayed), len(active), len(completed),
+            len(shed), replay_s)
 
     @classmethod
     def from_saved(cls, directory, **kwargs) -> "ServeEngine":
@@ -174,8 +334,65 @@ class ServeEngine:
                 f"{self.max_len}-position cache slot (need >= 1 free)")
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       eos_id=eos_id, deadline_s=deadline_s)
+        cause = self._shed_cause(req)
+        if cause is not None:
+            return self._shed(req, cause)
         self.scheduler.submit(req, now=self.clock())
         metrics.inc("serve.requests.submitted")
+        if self.journal is not None:
+            self.journal.record_submit(req)
+        return req
+
+    # -- overload protection --------------------------------------------------
+
+    def _projected_ttft_s(self) -> float:
+        """Conservative time-to-first-token estimate for a request joining
+        the queue now: every token owed by work ahead of it (active
+        remainders + whole queued requests), spread over ``max_batch``
+        lanes, at the EMA decode-step time. 0.0 until the first decode
+        step has been measured."""
+        if self._step_ema_s is None:
+            return 0.0
+        owed = sum(max(r.max_new_tokens - len(r.generated), 0)
+                   for r in self.scheduler.active())
+        owed += sum(r.max_new_tokens for r in self.scheduler.queue)
+        return (owed / self.max_batch) * self._step_ema_s
+
+    def _shed_cause(self, req: Request) -> Optional[str]:
+        """Admission control, cheapest check first: queue bound, then
+        deadline feasibility (could this request meet its deadline even if
+        admitted immediately?), then projected TTFT."""
+        if self.scheduler.full():
+            return "queue_full"
+        projected = self._projected_ttft_s()
+        if req.deadline_s is not None and self._step_ema_s is not None:
+            need = projected + req.max_new_tokens * self._step_ema_s
+            if need > req.deadline_s:
+                return "deadline_unmeetable"
+        if self.max_ttft_s is not None and projected > self.max_ttft_s:
+            return "projected_ttft"
+        return None
+
+    def _shed(self, req: Request, cause: str, *,
+              journaled: bool = False) -> Request:
+        """Reject ``req`` at admission: terminal SHED state, never a slot.
+        Journaled (submit + finish) so a post-crash replay does not
+        resurrect it — shed is an answer, not a loss."""
+        if req.rid < 0:
+            req.rid = self.scheduler.reserve_rid()
+        req.status = SHED
+        req.finish_reason = "shed"
+        req.shed_cause = cause
+        now = self.clock()
+        req.submit_s = req.submit_s or now
+        req.finish_s = now
+        self.finished.append(req)
+        metrics.inc("serve.requests.shed")
+        if self.journal is not None:
+            if not journaled:
+                self.journal.record_submit(req)
+            self.journal.record_finish(req)
+        logger.info("serve: shed request %d (%s)", req.rid, cause)
         return req
 
     # -- sampling (host-side) -------------------------------------------------
@@ -202,7 +419,10 @@ class ServeEngine:
         swap = self.scheduler.finish(req, now=now, status=status)
         self._apply_swap(swap)
         self.finished.append(req)
+        if self.journal is not None:
+            self.journal.record_finish(req)
         if status == DONE:
+            self._done_count += 1
             metrics.inc("serve.requests.completed")
             if req.latency_s is not None:
                 metrics.observe_value("serve.request.latency_s",
@@ -213,10 +433,15 @@ class ServeEngine:
             metrics.inc("serve.requests.evicted")
 
     def _prefill(self, req: Request) -> None:
-        plen = len(req.prompt)
+        # A journal-recovered request re-prefills with prompt + everything
+        # it had already generated: the incremental-decode ≡ full-forward
+        # equivalence makes the greedy continuation token-identical to an
+        # uninterrupted run (req.generated is empty on the normal path).
+        seq = list(req.prompt) + list(req.generated)
+        plen = len(seq)
         pad = _pad_to_pow2(plen, hi=self.max_len)
         tokens = np.zeros(pad, np.int32)
-        tokens[:plen] = req.prompt
+        tokens[:plen] = seq
         fn = self._prefill_fn(pad)
         self.cache, logits = fn(self.params, self.cache,
                                 jnp.asarray(tokens), jnp.int32(plen),
@@ -226,6 +451,8 @@ class ServeEngine:
         token = self._pick(np.asarray(logits))
         done = self.scheduler.record_token(req, token, now=now)
         metrics.inc("serve.tokens.generated")
+        if self.journal is not None:
+            self.journal.record_token(req.rid, token)
         self._tokens[req.slot] = token
         self._lengths[req.slot] = plen
         if done or plen >= self.max_len:
@@ -234,12 +461,20 @@ class ServeEngine:
     def step(self) -> int:
         """One scheduling round: deadline evictions → admissions (each
         pays its prefill and emits its first token) → one decode step for
-        the active bucket. Returns the number of still-active requests."""
+        the active bucket. Returns the number of still-active requests.
+
+        Durability contract: everything journaled this round (submits,
+        tokens, finishes) is flushed — one append + fsync — at the END of
+        the round, after the fault-injector seams, so an injected crash
+        loses the unflushed tail and recovery must regenerate it (the
+        harsher ordering for the parity gate)."""
         now = self.clock()
         for req, swap in self.scheduler.evict_deadline(now=now):
             self._apply_swap(swap)
             self.finished.append(req)
             metrics.inc("serve.requests.evicted")
+            if self.journal is not None:
+                self.journal.record_finish(req)
 
         for req in self.scheduler.admit():
             self._prefill(req)
@@ -247,14 +482,40 @@ class ServeEngine:
 
         n = self.scheduler.num_active
         if n == 0:
+            if self.journal is not None:
+                self.journal.flush()
             return 0
         bucket = self.scheduler.bucket()
         metrics.observe_value("serve.batch.occupancy", n / bucket)
-        self.cache, logits = self._decode_fn(bucket)(
-            self.params, self.cache, jnp.asarray(self._tokens),
-            jnp.asarray(self._lengths))
+        t0 = self.clock()
+        timer = None
+        if self.stall_timeout_s is not None:
+            info = {"timeout_s": self.stall_timeout_s, "bucket": bucket,
+                    "active": n}
+            timer = threading.Timer(self.stall_timeout_s,
+                                    self.stall_action, args=(info,))
+            timer.daemon = True
+            timer.start()
+        try:
+            self.cache, logits = self._decode_fn(bucket)(
+                self.params, self.cache, jnp.asarray(self._tokens),
+                jnp.asarray(self._lengths))
+            if self.fault_injector is not None:
+                # Inside the watchdog window on purpose: a decode_stall
+                # fault must look exactly like a hung runtime call.
+                self.fault_injector.on_decode()
+            logits = np.asarray(logits)  # blocks until the device is done
+        finally:
+            if timer is not None:
+                timer.cancel()
         metrics.inc("serve.decode.steps")
-        logits = np.asarray(logits)
+        if self.virtual_step_s > 0.0 and hasattr(self.clock, "advance"):
+            self.clock.advance(self.virtual_step_s)
+        dt = self.clock() - t0
+        if dt > 0.0:
+            self._step_ema_s = (dt if self._step_ema_s is None else
+                                _EMA_ALPHA * dt
+                                + (1.0 - _EMA_ALPHA) * self._step_ema_s)
         now = self.clock()
         completed = []
         for req in self.scheduler.active():
@@ -263,11 +524,17 @@ class ServeEngine:
             self._tokens[req.slot] = token
             done = self.scheduler.record_token(req, token, now=now)
             metrics.inc("serve.tokens.generated")
+            if self.journal is not None:
+                self.journal.record_token(req.rid, token)
             if done or self._lengths[req.slot] >= self.max_len:
                 completed.append(req)
         # Highest slot first: each swap moves the (untouched) last slot.
         for req in sorted(completed, key=lambda r: r.slot, reverse=True):
             self._retire(req, now=now, status=DONE)
+        if self.fault_injector is not None:
+            self.fault_injector.on_step_end(self._done_count)
+        if self.journal is not None:
+            self.journal.flush()
         return self.scheduler.num_active
 
     def run_until_idle(self, *, max_steps: int = 100_000) -> list[Request]:
@@ -291,3 +558,21 @@ class ServeEngine:
                           eos_id=eos_id)
         self.run_until_idle()
         return req.generated
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def close(self) -> None:
+        """Flush + close the journal and drop readiness. Idempotent; a
+        crash skips it by definition — that is what recovery is for."""
+        if self._closed:
+            return
+        self._closed = True
+        metrics.set_gauge("serve.ready", 0.0)
+        if self.journal is not None:
+            self.journal.close()
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

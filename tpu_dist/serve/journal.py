@@ -1,0 +1,249 @@
+"""Durable request journal: the serve engine's crash-recovery log.
+
+An append-only JSONL file recording every request's lifecycle — ``submit``
+(prompt + generation knobs), ``token`` (each emitted token), ``finish``
+(terminal status + reason) and ``replay`` (one marker per crash recovery,
+naming the requests that were in flight) — so a supervised engine restart
+can reconstruct exactly where serving stood:
+
+* requests journaled ``submit`` but never ``finish`` and with no tokens
+  are **queued**: re-admitted in arrival order;
+* requests with tokens but no ``finish`` were **active** mid-decode:
+  re-prefilled with ``prompt + tokens_emitted_so_far``, which makes the
+  greedy continuation token-identical to an uninterrupted run (the
+  incremental-decode ≡ full-forward equivalence ``test_serve.py`` pins);
+* requests whose journaled tokens already satisfy their stop condition
+  (EOS flushed, length reached) finish **during replay** — their terminal
+  record was lost in the crash, not their work.
+
+Durability model: records are **buffered in memory and flushed once per
+decode step** — a single ``write`` of the whole batch followed by an
+``fsync`` (the same durability discipline as ``training/checkpoint.py``:
+atomicity is not durability; data sitting in the page cache is lost to a
+crash). One fsync per decode step keeps the journal off the per-token hot
+path; everything since the last flush is regenerated deterministically on
+replay, so the flush granularity bounds *recomputation*, never
+*correctness*. A writer killed mid-flush leaves at most one torn trailing
+line, which :func:`load` skips exactly like the resilience event log does.
+
+The journal is host-side and jax-free on purpose: it records scheduling
+truth, never touches device buffers, and adds zero bytes to the compiled
+``serve.decode_step`` program (pinned by the analysis cost baseline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+from typing import Optional
+
+#: Environment variable a supervised serve worker reads its journal
+#: directory from (set by the serve chaos driver / ServeSupervisor).
+JOURNAL_DIR_ENV = "TPU_DIST_SERVE_JOURNAL"
+
+#: Journal file name inside the journal directory.
+JOURNAL_NAME = "journal.jsonl"
+
+
+class RequestJournal:
+    """Buffered, fsync'd append-only journal for one serving process.
+
+    Args:
+      directory: journal directory (created if missing); the JSONL lives at
+        ``<directory>/journal.jsonl``. An existing journal is APPENDED to —
+        recovery reads it first (:func:`load`), then the recovered engine
+        keeps writing to the same file, so the full request history
+        survives any number of restarts.
+      fsync: set False to skip the per-flush fsync (tests on tmpfs; a
+        production engine keeps it on — a journal that loses its tail to
+        the page cache silently re-queues shed work).
+    """
+
+    def __init__(self, directory: str | os.PathLike, *, fsync: bool = True):
+        self.directory = pathlib.Path(directory)
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.path = self.directory / JOURNAL_NAME
+        self.fsync = bool(fsync)
+        self._buf: list[str] = []
+        self._closed = False
+
+    # -- record builders (buffered) ------------------------------------------
+
+    def _put(self, rec: dict) -> None:
+        if self._closed:
+            raise RuntimeError(f"journal {self.path} is closed")
+        self._buf.append(json.dumps(rec))
+
+    def record_submit(self, req) -> None:
+        self._put({"rec": "submit", "rid": int(req.rid),
+                   "prompt": [int(t) for t in req.prompt],
+                   "max_new_tokens": int(req.max_new_tokens),
+                   "eos_id": (None if req.eos_id is None
+                              else int(req.eos_id)),
+                   "deadline_s": req.deadline_s,
+                   "ts": round(time.time(), 6)})
+
+    def record_token(self, rid: int, token: int) -> None:
+        self._put({"rec": "token", "rid": int(rid), "t": int(token)})
+
+    def record_finish(self, req) -> None:
+        self._put({"rec": "finish", "rid": int(req.rid),
+                   "status": req.status, "reason": req.finish_reason,
+                   "ts": round(time.time(), 6)})
+
+    def record_replay(self, *, attempt: int, queued: list, active: list,
+                      completed: list, replay_s: float) -> None:
+        """One marker per crash recovery. ``active`` is what counts against
+        each request's retry budget: those are the requests that were being
+        decoded when the engine died (the poison-pill suspects)."""
+        self._put({"rec": "replay", "attempt": int(attempt),
+                   "queued": [int(r) for r in queued],
+                   "active": [int(r) for r in active],
+                   "completed": [int(r) for r in completed],
+                   "replay_s": round(float(replay_s), 6),
+                   "ts": round(time.time(), 6)})
+        self.flush()
+
+    # -- durability ----------------------------------------------------------
+
+    def flush(self) -> int:
+        """Write every buffered record as ONE append + fsync; returns the
+        number of records flushed. Called by the engine between decode
+        steps — the batched-fsync contract in the module docstring."""
+        if not self._buf:
+            return 0
+        n = len(self._buf)
+        data = "\n".join(self._buf) + "\n"
+        self._buf = []
+        with open(self.path, "a", encoding="utf-8") as fh:
+            fh.write(data)
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+        return n
+
+    def close(self) -> None:
+        if not self._closed:
+            self.flush()
+            self._closed = True
+
+    def __enter__(self) -> "RequestJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class JournaledRequest:
+    """Replay-side view of one journaled request."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_id", "deadline_s",
+                 "tokens", "status", "finish_reason", "order", "replays")
+
+    def __init__(self, rid: int, *, prompt: list, max_new_tokens: int,
+                 eos_id: Optional[int], deadline_s: Optional[float],
+                 order: int):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.eos_id = eos_id
+        self.deadline_s = deadline_s
+        self.tokens: list[int] = []
+        self.status: Optional[str] = None      # terminal status, if finished
+        self.finish_reason: Optional[str] = None
+        self.order = order                     # arrival order (submit index)
+        self.replays = 0                       # times caught ACTIVE in a crash
+
+    @property
+    def finished(self) -> bool:
+        return self.status is not None
+
+    def stop_satisfied(self) -> bool:
+        """True when the journaled tokens already meet the request's stop
+        condition — the terminal record was lost, not the work."""
+        if self.eos_id is not None and self.eos_id in self.tokens:
+            return True
+        return len(self.tokens) >= self.max_new_tokens
+
+    def implied_finish_reason(self) -> str:
+        if self.eos_id is not None and self.eos_id in self.tokens:
+            return "eos"
+        return "length"
+
+
+class JournalState:
+    """Everything :func:`load` reconstructs from a journal file."""
+
+    def __init__(self):
+        self.requests: dict[int, JournaledRequest] = {}
+        self.replay_markers: list[dict] = []
+        self.records = 0
+
+    @property
+    def known_rids(self) -> set:
+        return set(self.requests)
+
+    @property
+    def next_rid(self) -> int:
+        return max(self.requests, default=-1) + 1
+
+    def pending(self) -> tuple[list, list]:
+        """``(active, queued)`` in arrival order: active = unfinished with
+        tokens (were mid-decode), queued = unfinished without tokens."""
+        unfinished = sorted((r for r in self.requests.values()
+                             if not r.finished), key=lambda r: r.order)
+        active = [r for r in unfinished if r.tokens]
+        queued = [r for r in unfinished if not r.tokens]
+        return active, queued
+
+
+def load(path: str | os.PathLike) -> JournalState:
+    """Replay a journal file into a :class:`JournalState`. Unparseable
+    (torn) lines are skipped — crash recovery reads journals whose writer
+    died mid-append, by design. A missing file is an empty state."""
+    state = JournalState()
+    try:
+        fh = open(path, "r", encoding="utf-8")
+    except FileNotFoundError:
+        return state
+    with fh:
+        for line in fh:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            kind = rec.get("rec")
+            state.records += 1
+            if kind == "submit":
+                rid = int(rec["rid"])
+                state.requests[rid] = JournaledRequest(
+                    rid, prompt=list(rec.get("prompt", [])),
+                    max_new_tokens=int(rec.get("max_new_tokens", 0)),
+                    eos_id=rec.get("eos_id"),
+                    deadline_s=rec.get("deadline_s"),
+                    order=len(state.requests))
+            elif kind == "token":
+                jr = state.requests.get(int(rec.get("rid", -1)))
+                if jr is not None:
+                    jr.tokens.append(int(rec["t"]))
+            elif kind == "finish":
+                jr = state.requests.get(int(rec.get("rid", -1)))
+                if jr is not None:
+                    jr.status = rec.get("status")
+                    jr.finish_reason = rec.get("reason")
+            elif kind == "replay":
+                state.replay_markers.append(rec)
+                for rid in rec.get("active", []):
+                    jr = state.requests.get(int(rid))
+                    if jr is not None:
+                        jr.replays += 1
+    return state
+
+
+def journal_dir_from_env() -> Optional[str]:
+    """The journal directory named by ``$TPU_DIST_SERVE_JOURNAL``, or None
+    when this process serves without crash recovery."""
+    d = os.environ.get(JOURNAL_DIR_ENV)
+    return d if d else None

@@ -33,8 +33,12 @@ import dataclasses
 import itertools
 from typing import Optional
 
-#: Request lifecycle states.
-QUEUED, ACTIVE, DONE, EVICTED = "queued", "active", "done", "evicted"
+#: Request lifecycle states. SHED is terminal like DONE/EVICTED but
+#: mutually exclusive with both: a shed request was REJECTED at admission
+#: (queue bound, projected-TTFT/deadline infeasibility, or retry-budget
+#: exhaustion on journal replay) and never occupied a slot.
+QUEUED, ACTIVE, DONE, EVICTED, SHED = ("queued", "active", "done",
+                                       "evicted", "shed")
 
 
 @dataclasses.dataclass
@@ -52,7 +56,13 @@ class Request:
     submit_s: float = 0.0
     first_token_s: Optional[float] = None
     finish_s: Optional[float] = None
-    finish_reason: Optional[str] = None  #: eos | length | deadline
+    finish_reason: Optional[str] = None  #: eos | length | deadline | shed
+    #: Why a SHED request was rejected: queue_full | projected_ttft |
+    #: deadline_unmeetable | retry_budget (finish_reason stays "shed").
+    shed_cause: Optional[str] = None
+    #: Crash-recovery replays this request has survived (journal replay
+    #: counts it each time the request was ACTIVE when the engine died).
+    replays: int = 0
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -83,13 +93,20 @@ class Scheduler:
 
     def __init__(self, max_batch: int, *,
                  buckets: Optional[tuple[int, ...]] = None,
-                 policy: str = "continuous"):
+                 policy: str = "continuous",
+                 max_queue: Optional[int] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if policy not in ("continuous", "static"):
             raise ValueError(f"unknown policy {policy!r}")
+        if max_queue is not None and max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
         self.max_batch = max_batch
         self.policy = policy
+        #: Bounded admission queue: ``submit`` of a request the engine did
+        #: not shed still raises past this depth (belt and braces — the
+        #: engine's shed path is the polite rejection). None = unbounded.
+        self.max_queue = max_queue
         self.buckets = tuple(sorted(set(buckets or
                                         default_buckets(max_batch))))
         if self.buckets[-1] != max_batch:
@@ -105,15 +122,37 @@ class Scheduler:
 
     # -- intake ---------------------------------------------------------------
 
-    def submit(self, req: Request, *, now: float) -> Request:
+    def submit(self, req: Request, *, now: float,
+               rid: Optional[int] = None) -> Request:
+        """Queue a request. ``rid`` pins a journal-recovered request to its
+        original id (the rid counter jumps past it); fresh submissions get
+        the next sequential id."""
         if not req.prompt:
             raise ValueError("empty prompt")
-        req.rid = self._next_rid
-        self._next_rid += 1
+        if self.full():
+            raise RuntimeError(
+                f"admission queue full ({len(self.queue)} >= "
+                f"{self.max_queue}); shed before submitting")
+        if rid is None:
+            rid = self._next_rid
+        req.rid = rid
+        self._next_rid = max(self._next_rid, rid + 1)
         req.submit_s = now
         req.status = QUEUED
         self.queue.append(req)
         return req
+
+    def reserve_rid(self) -> int:
+        """Consume the next request id without queueing anything — shed
+        requests still need a stable id for the journal and the report."""
+        rid = self._next_rid
+        self._next_rid += 1
+        return rid
+
+    def full(self) -> bool:
+        """True when the bounded admission queue is at capacity."""
+        return (self.max_queue is not None
+                and len(self.queue) >= self.max_queue)
 
     # -- admission ------------------------------------------------------------
 
