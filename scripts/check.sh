@@ -136,6 +136,35 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python -m tpu_dist.serve --chaos \
        exit 1; }
 rm -rf "$chaos_dir"
 
+echo "== jobs-smoke: multi-job blast radius + failed-job classification =="
+# The multi-tenant chaos gate from README.md "Multi-job scheduling": pack
+# 3 jobs (train survivor, train target, serve survivor) onto the 8-slot
+# virtual pool and arm job_kill@job1. Gates inside the CLI: the fault
+# fired in the target's gang (anti-vacuity), the target restarted and
+# recovered to EXACT solo parity, every survivor finished with ZERO
+# restarts and solo-identical losses/token streams (blast radius zero),
+# and the untargeted event logs carry no fault at all. A second phase
+# arms job_kill@job1:abort and requires the target marked failed with
+# classification job_abort and no restart.
+jobs_dir=$(mktemp -d /tmp/tpu-dist-jobs.XXXXXX)
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.jobs --chaos \
+  --workdir "$jobs_dir" >/dev/null \
+  || { echo "check.sh: jobs chaos gates failed (see $jobs_dir)" >&2; exit 1; }
+rm -rf "$jobs_dir"
+
+echo "== jobs-bench: packed makespan vs serial =="
+# Packs the demo mix (2 train + 2 paced serve jobs, one 2-device slice
+# each) onto the 8-slot pool; writes BENCH_JOBS.json. Gates: every job in
+# BOTH legs completed, and packed makespan <= 0.8x the serial sum — the
+# packing win is the serve jobs' paced arrival gaps backfilled by the
+# train jobs' compute.
+jobs_bench_dir=$(mktemp -d /tmp/tpu-dist-jobs-bench.XXXXXX)
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.jobs --bench \
+  --workdir "$jobs_bench_dir" --report BENCH_JOBS.json >/dev/null \
+  || { echo "check.sh: jobs bench gates failed (see BENCH_JOBS.json)" >&2
+       exit 1; }
+rm -rf "$jobs_bench_dir"
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

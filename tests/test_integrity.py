@@ -17,7 +17,7 @@ import tpu_dist as td
 from tpu_dist.resilience import FAULT_PLAN_ENV, FaultPlan, read_events
 from tpu_dist.resilience.events import EVENT_LOG_ENV
 from tpu_dist.resilience.faults import (EXIT_CODES, EXIT_FAULT_KILL,
-                                        EXIT_INTEGRITY,
+                                        EXIT_INTEGRITY, EXIT_JOB_ABORT,
                                         EXIT_PEER_UNAVAILABLE,
                                         EXIT_PREEMPTED, EXIT_SERVE_ABORT,
                                         _PROTOCOL_EXITS, classify_exit_code)
@@ -50,10 +50,12 @@ class TestExitRegistry:
         assert EXIT_CODES[EXIT_PREEMPTED] == "preempted"
         assert EXIT_CODES[EXIT_INTEGRITY] == "integrity_abort"
         assert EXIT_CODES[EXIT_SERVE_ABORT] == "serve_abort"
+        assert EXIT_CODES[EXIT_JOB_ABORT] == "job_abort"
 
     def test_classify_exit_code(self):
         assert classify_exit_code(0) == "clean"
         assert classify_exit_code(EXIT_INTEGRITY) == "integrity_abort"
+        assert classify_exit_code(EXIT_JOB_ABORT) == "job_abort"
         assert classify_exit_code(1) == "crash"
         assert classify_exit_code(-15) == "signal_15"
 

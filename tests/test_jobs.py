@@ -1,0 +1,447 @@
+"""tpu_dist.jobs tests: the multi-tenant job runtime.
+
+Layers, inside out: JobSpec validation + wire format + the job-name RNG
+fold-in; JobNamespace derivation (paths, metric prefixes, loud no-root
+errors); MeshRuntime submesh leasing (divisor rule, alignment,
+fragmentation, double-release) and the pool-owned compiled-program cache;
+PackingScheduler admission order (priority desc, FIFO within, backfill)
+and the job state machine; job_scope placement; the job-coordinate fault
+grammar; and the properties the subsystem exists for —
+
+* **namespace isolation**: the same JobSpec run solo on the pool and run
+  packed beside neighbors (landing on a DIFFERENT submesh slice) yields
+  bit-identical losses / token streams / checkpoint arrays;
+* **per-job fault domains** (subprocess JobPool on the 8-slot virtual
+  pool, 2 gangs of 4): ``job_kill@job1`` restarts only job 1, the
+  survivor finishes with zero restarts, the fault fires only in the
+  target's event log, and BOTH jobs' results still match their solo
+  baselines bit for bit; ``:abort`` marks the target failed with
+  classification ``job_abort`` and no restart.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_dist.jobs.runtime import (JobContext, MeshRuntime, current_job,
+                                   job_scope)
+from tpu_dist.jobs.scheduler import (DONE, FAILED, QUEUED, RUNNING, JobPool,
+                                     JobRecord, PackingScheduler, _pool_env)
+from tpu_dist.jobs.spec import (JOB_ROOT_ENV, JOB_SPEC_ENV, JobNamespace,
+                                JobSpec, derive_job_seed)
+from tpu_dist.jobs.worker import run_inline
+from tpu_dist.resilience import events
+from tpu_dist.resilience.faults import (EXIT_FAULT_KILL, EXIT_JOB_ABORT,
+                                        FAULT_PLAN_ENV, JOB_INDEX_ENV,
+                                        FaultPlan, FaultSpec)
+
+
+class TestJobSpec:
+    def test_defaults_and_budgets(self):
+        spec = JobSpec(name="a")
+        assert spec.kind == "train" and spec.devices == 1
+        assert spec.total_steps == spec.epochs * spec.steps_per_epoch
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="unknown job kind"):
+            JobSpec(name="a", kind="batch")
+        with pytest.raises(ValueError, match="job name"):
+            JobSpec(name="")
+        with pytest.raises(ValueError, match="job name"):
+            JobSpec(name="no spaces allowed")
+        with pytest.raises(ValueError, match="devices must be >= 1"):
+            JobSpec(name="a", devices=0)
+        with pytest.raises(ValueError, match="arrival_s must be >= 0"):
+            JobSpec(name="a", arrival_s=-0.5)
+
+    def test_json_roundtrip(self):
+        spec = JobSpec(name="t-1", kind="serve", devices=2, priority=3,
+                       seed=7, requests=6, max_new=5, arrival_s=0.25)
+        assert JobSpec.from_json(spec.to_json()) == spec
+        with pytest.raises(ValueError, match="unknown JobSpec field"):
+            JobSpec.from_json(spec.to_json() | {"gpus": 4})
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.delenv(JOB_SPEC_ENV, raising=False)
+        assert JobSpec.from_env() is None
+        spec = JobSpec(name="enviro", devices=2)
+        monkeypatch.setenv(JOB_SPEC_ENV, spec.dumps())
+        assert JobSpec.from_env() == spec
+
+
+class TestNamespace:
+    def test_seed_depends_on_name_and_base_only(self):
+        a = derive_job_seed("alpha", 0)
+        assert derive_job_seed("alpha", 0) == a        # stable
+        assert derive_job_seed("bravo", 0) != a        # name enters
+        assert derive_job_seed("alpha", 1) != a        # base seed enters
+        assert 0 <= a < 2 ** 31
+
+    def test_paths_and_metrics(self, tmp_path):
+        ns = JobNamespace(JobSpec(name="alpha"), tmp_path)
+        assert ns.checkpoint_dir == tmp_path / "jobs" / "alpha" / "ckpt"
+        assert ns.event_log == tmp_path / "jobs" / "alpha" / "events.jsonl"
+        assert ns.journal_dir == tmp_path / "jobs" / "alpha" / "journal"
+        assert ns.metric("loss") == "job.alpha.loss"
+        assert ns.seed == derive_job_seed("alpha", 0)
+
+    def test_rootless_namespace_raises_on_paths(self):
+        ns = JobNamespace(JobSpec(name="alpha"), None)
+        assert ns.metric_prefix == "job.alpha."      # RNG/metric half works
+        with pytest.raises(RuntimeError, match="no root directory"):
+            _ = ns.checkpoint_dir
+
+
+class TestMeshRuntime:
+    def test_virtual_pool_arithmetic(self):
+        rt = MeshRuntime(8)
+        assert rt.pool_size == 8 and rt.devices is None
+        with pytest.raises(ValueError, match="pool size"):
+            MeshRuntime(0)
+        with pytest.raises(ValueError, match="must not be empty"):
+            MeshRuntime([])
+
+    def test_divisor_rule(self):
+        rt = MeshRuntime(8)
+        for ok in (1, 2, 4, 8):
+            assert rt.validate_request(ok) == ok
+        with pytest.raises(ValueError, match="does not divide"):
+            rt.validate_request(3)
+        with pytest.raises(ValueError, match="exceeds the pool"):
+            rt.validate_request(16)
+        with pytest.raises(ValueError, match=">= 1"):
+            rt.validate_request(0)
+
+    def test_lease_alignment_and_exhaustion(self):
+        rt = MeshRuntime(8)
+        a, b = rt.acquire(4), rt.acquire(4)
+        assert (a.start, a.size, b.start, b.size) == (0, 4, 4, 4)
+        assert rt.free_devices() == 0
+        assert rt.try_acquire(4) is None
+        with pytest.raises(RuntimeError, match="no free submesh"):
+            rt.acquire(4)
+        a.release()
+        c = rt.acquire(4)
+        assert c.start == 0          # freed slice is reusable
+        # A 2-wide request lands on an aligned boundary of ITS size, never
+        # inside a held slice.
+        c.release(), b.release()
+        rt.acquire(2)
+        d = rt.acquire(4)
+        assert d.start == 4          # [0:4] blocked by the 2-lease at 0
+
+    def test_double_release_is_loud(self):
+        rt = MeshRuntime(4)
+        lease = rt.acquire(2)
+        lease.release()
+        with pytest.raises(RuntimeError, match="double release"):
+            lease.release()
+
+    def test_virtual_lease_has_no_strategy(self):
+        lease = MeshRuntime(8).acquire(2)
+        assert lease.devices is None
+        with pytest.raises(RuntimeError, match="virtual-pool leases"):
+            lease.strategy()
+
+    def test_program_cache_builds_once(self):
+        rt = MeshRuntime(8)
+        built = []
+
+        def builder():
+            built.append(1)
+            return object()
+
+        first = rt.cached(("jobA", "m", 0, "train_step"), builder)
+        again = rt.cached(("jobA", "m", 0, "train_step"), builder)
+        assert first is again and len(built) == 1
+        assert rt.program_hits == 1
+        other = rt.cached(("jobB", "m", 0, "train_step"), builder)
+        assert other is not first and len(built) == 2
+        assert rt.program_keys() == [("jobA", "m", 0, "train_step"),
+                                     ("jobB", "m", 0, "train_step")]
+
+
+class TestPackingScheduler:
+    def test_submit_validates_early(self):
+        sched = PackingScheduler(MeshRuntime(8))
+        sched.submit(JobSpec(name="a", devices=2))
+        with pytest.raises(ValueError, match="does not divide"):
+            sched.submit(JobSpec(name="b", devices=3))
+        with pytest.raises(ValueError, match="duplicate job name"):
+            sched.submit(JobSpec(name="a", devices=2))
+
+    def test_admission_order_priority_then_fifo(self):
+        sched = PackingScheduler(MeshRuntime(8))
+        lo1 = sched.submit(JobSpec(name="lo1", devices=2, priority=0))
+        hi = sched.submit(JobSpec(name="hi", devices=2, priority=5))
+        lo2 = sched.submit(JobSpec(name="lo2", devices=2, priority=0))
+        assert sched.queued() == [hi, lo1, lo2]
+        record, lease = sched.next_admissible()
+        assert record is hi and lease.size == 2
+
+    def test_backfill_past_a_wide_waiter(self):
+        rt = MeshRuntime(8)
+        sched = PackingScheduler(rt)
+        wide = sched.submit(JobSpec(name="wide", devices=8, priority=9))
+        narrow = sched.submit(JobSpec(name="narrow", devices=2, priority=0))
+        blocker = rt.acquire(2)   # the pool is partially busy
+        record, lease = sched.next_admissible()
+        assert record is narrow   # backfilled past the un-placeable wide job
+        lease.release()
+        blocker.release()
+        record, lease = sched.next_admissible()
+        assert record is wide     # ... who is still offered every freed slice
+        lease.release()
+
+    def test_state_machine(self):
+        rt = MeshRuntime(8)
+        sched = PackingScheduler(rt)
+        rec = sched.submit(JobSpec(name="a", devices=2))
+        assert rec.state == QUEUED and rec.index == 0
+        record, lease = sched.next_admissible()
+        sched.mark_running(record, lease)
+        assert rec.state == RUNNING and sched.running() == [rec]
+        assert not sched.settled()
+        sched.mark_done(rec)
+        assert rec.state == DONE and sched.settled()
+        assert rec.lease.released and rt.free_devices() == 8
+        assert rec.duration_s is not None
+
+    def test_failed_records_classification(self):
+        sched = PackingScheduler(MeshRuntime(8))
+        rec = sched.submit(JobSpec(name="a", devices=2))
+        record, lease = sched.next_admissible()
+        sched.mark_running(record, lease)
+        sched.mark_failed(rec, classification="job_abort")
+        assert rec.state == FAILED
+        assert rec.to_json()["classification"] == "job_abort"
+
+    def test_record_json_shape(self):
+        rec = JobRecord(JobSpec(name="a", kind="serve", devices=2,
+                                priority=1), index=3)
+        j = rec.to_json()
+        assert j["name"] == "a" and j["index"] == 3
+        assert j["state"] == QUEUED and j["restarts"] == 0
+
+
+class TestJobScope:
+    def test_scope_pushes_context_and_releases(self, eight_devices):
+        rt = MeshRuntime(eight_devices)
+        assert current_job() is None
+        with job_scope(rt, JobSpec(name="scoped", devices=2)) as ctx:
+            assert isinstance(ctx, JobContext)
+            assert current_job() is ctx
+            assert ctx.lease.size == 2 and rt.free_devices() == 6
+            assert ctx.program_key("m", "train") == ("scoped", "m", "train")
+        assert current_job() is None and rt.free_devices() == 8
+
+    def test_scope_releases_on_error(self, eight_devices):
+        rt = MeshRuntime(eight_devices)
+        with pytest.raises(RuntimeError, match="boom"):
+            with job_scope(rt, JobSpec(name="err", devices=2)):
+                raise RuntimeError("boom")
+        assert current_job() is None and rt.free_devices() == 8
+
+    def test_nested_scopes_get_distinct_slices(self, eight_devices):
+        rt = MeshRuntime(eight_devices)
+        with job_scope(rt, JobSpec(name="outer", devices=4)) as outer:
+            with job_scope(rt, JobSpec(name="inner", devices=2)) as inner:
+                assert current_job() is inner
+                held = set(range(outer.lease.start,
+                                 outer.lease.start + outer.lease.size))
+                taken = set(range(inner.lease.start,
+                                  inner.lease.start + inner.lease.size))
+                assert not held & taken
+            assert current_job() is outer
+
+
+class TestJobFaultGrammar:
+    def test_job_kill_defaults(self):
+        (f,) = FaultPlan.parse("job_kill@job1").faults
+        assert f.kind == "job_kill" and f.job == 1
+        assert f.step == 1                # fires at the first step boundary
+        assert f.exit_code == EXIT_FAULT_KILL   # restartable by default
+        assert f.attempt == 0             # never re-fires after restart
+
+    def test_abort_and_step_modifiers(self):
+        (f,) = FaultPlan.parse("job_kill@job0:abort:step3").faults
+        assert f.exit_code == EXIT_JOB_ABORT and f.step == 3
+
+    def test_job_hang_seconds(self):
+        (f,) = FaultPlan.parse("job_hang@job2:5s").faults
+        assert f.kind == "job_hang" and f.seconds == 5.0
+
+    def test_job_coordinate_required_and_exclusive(self):
+        with pytest.raises(ValueError, match="needs a job coordinate"):
+            FaultSpec(kind="job_kill", step=1)
+        with pytest.raises(ValueError, match="not a job kind"):
+            FaultSpec(kind="kill", job=1, step=1)
+
+    def test_matches_job_filter(self):
+        f = FaultPlan.parse("job_kill@job1").faults[0]
+        assert f.matches_job(1)
+        assert not f.matches_job(0)
+        assert not f.matches_job(None)    # stray plan outside any pool
+        bare = FaultSpec(kind="kill", step=1)
+        assert bare.matches_job(None) and bare.matches_job(7)
+
+    def test_json_roundtrip_keeps_job(self):
+        plan = FaultPlan.parse("job_kill@job1:abort, job_hang@job0:2s")
+        assert FaultPlan.parse(plan.dumps()) == plan
+
+    def test_injector_filters_by_job_index(self, monkeypatch):
+        from tpu_dist.resilience.injector import maybe_injector_from_env
+
+        monkeypatch.setenv(FAULT_PLAN_ENV, "job_kill@job1")
+        monkeypatch.setenv(JOB_INDEX_ENV, "0")
+        # Other gang: the job-coordinate fault never arms there.
+        assert maybe_injector_from_env(steps_per_epoch=4, rank=0,
+                                       attempt=0) is None
+        monkeypatch.setenv(JOB_INDEX_ENV, "1")
+        inj = maybe_injector_from_env(steps_per_epoch=4, rank=0, attempt=0)
+        assert inj is not None
+        assert [f.kind for f in inj.faults] == ["job_kill"]
+
+    def test_pool_env_strips_job_wiring(self, monkeypatch):
+        monkeypatch.setenv(JOB_SPEC_ENV, "{}")
+        monkeypatch.setenv(JOB_INDEX_ENV, "3")
+        monkeypatch.setenv(FAULT_PLAN_ENV, "kill@step1")
+        env = _pool_env({"KEEP": "1"})
+        assert JOB_SPEC_ENV not in env and JOB_INDEX_ENV not in env
+        assert FAULT_PLAN_ENV not in env and env["KEEP"] == "1"
+
+
+def _ckpt_arrays(ckpt_dir):
+    """Every checkpoint array under ``ckpt_dir``, keyed by relative npz
+    path + leaf name — the bit-identity payload for solo-vs-packed."""
+    out = {}
+    for npz in sorted(ckpt_dir.rglob("arrays.npz")):
+        with np.load(npz) as z:
+            for key in z.files:
+                out[(str(npz.relative_to(ckpt_dir)), key)] = z[key]
+    return out
+
+
+class TestIsolationParity:
+    """The namespace-isolation property: a job's results depend on its
+    spec alone — never on placement, neighbors, or submission order."""
+
+    def _packed_run(self, spec, root, eight_devices):
+        """Run ``spec`` with both neighboring slices of the pool HELD, so
+        its lease lands on a different submesh than a solo run's."""
+        rt = MeshRuntime(eight_devices)
+        neighbors = [rt.acquire(2), rt.acquire(2)]
+        try:
+            result = run_inline(rt, spec, root=root)
+            keys = rt.program_keys()
+        finally:
+            for lease in neighbors:
+                lease.release()
+        return result, keys
+
+    def test_train_solo_vs_packed_bit_identical(self, tmp_path,
+                                                eight_devices):
+        spec = JobSpec(name="iso-train", devices=2, epochs=2,
+                       steps_per_epoch=3, batch=8)
+        solo_rt = MeshRuntime(eight_devices)
+        solo = run_inline(solo_rt, spec, root=tmp_path / "solo")
+        packed, keys = self._packed_run(spec, tmp_path / "packed",
+                                        eight_devices)
+        assert solo["losses"] == packed["losses"] != []
+        assert solo["final_loss"] == packed["final_loss"]
+        assert solo["metrics"].keys() == packed["metrics"].keys()
+        assert all(k.startswith("job.iso-train.") for k in solo["metrics"])
+        # The packed run's compiled programs live in the POOL cache, keyed
+        # by the job's name — the MeshRuntime acquisition path.
+        assert keys and all(k[0] == "iso-train" for k in keys)
+        # Checkpoints land in per-job namespaces and are bit-identical.
+        solo_arrays = _ckpt_arrays(tmp_path / "solo" / "jobs" / spec.name
+                                   / "ckpt")
+        packed_arrays = _ckpt_arrays(tmp_path / "packed" / "jobs"
+                                     / spec.name / "ckpt")
+        assert solo_arrays and solo_arrays.keys() == packed_arrays.keys()
+        for key, arr in solo_arrays.items():
+            assert np.array_equal(arr, packed_arrays[key]), (
+                f"checkpoint leaf {key} differs solo vs packed")
+
+    def test_serve_solo_vs_packed_bit_identical(self, tmp_path,
+                                                eight_devices):
+        spec = JobSpec(name="iso-serve", kind="serve", devices=2,
+                       requests=3, max_new=6)
+        solo = run_inline(MeshRuntime(eight_devices), spec,
+                          root=tmp_path / "solo")
+        packed, keys = self._packed_run(spec, tmp_path / "packed",
+                                        eight_devices)
+        assert solo["streams"] == packed["streams"]
+        assert solo["tokens"] == packed["tokens"] > 0
+        assert keys and all(k[0] == "iso-serve" for k in keys)
+        # The serve namespace journals under <root>/jobs/<name>/journal.
+        assert (tmp_path / "solo" / "jobs" / spec.name / "journal").exists()
+
+    def test_distinct_jobs_never_share_programs_or_streams(self,
+                                                           eight_devices):
+        rt = MeshRuntime(eight_devices)
+        a = run_inline(rt, JobSpec(name="tenant-a", devices=2, epochs=1,
+                                   steps_per_epoch=2))
+        b = run_inline(rt, JobSpec(name="tenant-b", devices=2, epochs=1,
+                                   steps_per_epoch=2))
+        # Different names → different fold-in seeds → different data.
+        assert a["losses"] != b["losses"]
+        owners = {k[0] for k in rt.program_keys()}
+        assert owners == {"tenant-a", "tenant-b"}
+
+
+@pytest.mark.multiprocess
+class TestJobPoolFaultDomains:
+    """Subprocess gangs on the 8-slot virtual pool: per-job fault domains.
+
+    The satellite shape from the issue: 2 jobs on 4+4 submesh slices,
+    kill one, assert the blast radius is exactly one job.
+    """
+
+    def _solo_losses(self, spec, eight_devices):
+        return run_inline(MeshRuntime(eight_devices), spec)["losses"]
+
+    def test_job_kill_blast_radius_zero(self, tmp_path, eight_devices):
+        survivor = JobSpec(name="alpha", devices=4, epochs=2,
+                           steps_per_epoch=3, batch=8)
+        target = JobSpec(name="bravo", devices=4, epochs=2,
+                         steps_per_epoch=3, batch=8)
+        report = JobPool([survivor, target], root=tmp_path, pool=8,
+                         plan="job_kill@job1", max_restarts=2,
+                         attempt_deadline_s=120.0, backoff_s=0.05).run()
+        by_name = {j["name"]: j for j in report["jobs"]}
+        assert report["done"] == 2 and report["failed"] == 0
+        # The fault domain: job 1 restarted, job 0 untouched.
+        assert by_name["bravo"]["restarts"] >= 1
+        assert by_name["alpha"]["restarts"] == 0
+        fired = {
+            name: events.read_events(
+                JobNamespace(spec, tmp_path).event_log, "fault_fired")
+            for name, spec in (("alpha", survivor), ("bravo", target))
+        }
+        assert fired["bravo"], "anti-vacuity: the kill never fired"
+        assert not fired["alpha"], (
+            f"fault leaked into the survivor's domain: {fired['alpha']}")
+        # Both jobs — survivor AND restarted target — match their solo
+        # baselines bit for bit (the kill lands before any checkpoint, so
+        # the restart replays the whole loss series).
+        assert by_name["alpha"]["result"]["losses"] == self._solo_losses(
+            survivor, eight_devices)
+        assert by_name["bravo"]["result"]["losses"] == self._solo_losses(
+            target, eight_devices)
+
+    def test_job_abort_fails_without_restart(self, tmp_path):
+        jobs = [JobSpec(name="ok", devices=4, epochs=1, steps_per_epoch=2),
+                JobSpec(name="doomed", devices=4, epochs=1,
+                        steps_per_epoch=2)]
+        report = JobPool(jobs, root=tmp_path, pool=8,
+                         plan="job_kill@job1:abort", max_restarts=2,
+                         attempt_deadline_s=120.0, backoff_s=0.05).run()
+        by_name = {j["name"]: j for j in report["jobs"]}
+        assert by_name["ok"]["state"] == DONE
+        assert by_name["doomed"]["state"] == FAILED
+        assert by_name["doomed"]["classification"] == "job_abort"
+        assert by_name["doomed"]["restarts"] == 0   # restart cannot help

@@ -128,6 +128,141 @@ class TestJournal:
             j.record_token(0, 1)
 
 
+class TestJournalRotation:
+    """Compaction: finished requests' records are dropped at rotation, but
+    the rid space (idempotent resubmission + next_rid allocation) and every
+    unfinished trail read back exactly as before."""
+
+    def _journal_with_mixed_state(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync=False)
+        fin = Request(prompt=[1, 2], max_new_tokens=2, rid=0)
+        mid = Request(prompt=[3], max_new_tokens=4, rid=1)
+        new = Request(prompt=[4, 5], max_new_tokens=3, rid=2)
+        for r in (fin, mid, new):
+            j.record_submit(r)
+        j.record_token(0, 11)
+        j.record_token(0, 12)
+        fin.status = DONE
+        fin.finish_reason = "length"
+        j.record_finish(fin)
+        j.record_token(1, 9)
+        j.flush()
+        return j
+
+    def test_rotate_drops_finished_keeps_unfinished(self, tmp_path):
+        j = self._journal_with_mixed_state(tmp_path)
+        before = journal_lib.load(j.path)
+        size_before = j.path.stat().st_size
+        marker = j.rotate()
+        assert marker["finished_rids"] == [0] and marker["rotations"] == 1
+        assert j.path.stat().st_size < size_before  # compaction shrank it
+        after = journal_lib.load(j.path)
+        # The rid space is intact: rid 0 is still known (a replayed
+        # resubmission stays idempotent) and next_rid still clears it.
+        assert after.known_rids == before.known_rids == {0, 1, 2}
+        assert after.next_rid == before.next_rid == 3
+        assert 0 not in after.requests and after.compacted_rids == {0}
+        # Unfinished trails survive verbatim.
+        assert after.requests[1].tokens == [9]
+        assert after.requests[2].tokens == []
+        active, queued = after.pending()
+        assert [r.rid for r in active] == [1]
+        assert [r.rid for r in queued] == [2]
+
+    def test_rotations_accumulate_finished_rids(self, tmp_path):
+        j = self._journal_with_mixed_state(tmp_path)
+        j.rotate()
+        mid = Request(prompt=[3], max_new_tokens=4, rid=1)
+        mid.generated = [9, 8]
+        mid.status = DONE
+        mid.finish_reason = "length"
+        j.record_token(1, 8)
+        j.record_finish(mid)
+        j.flush()
+        marker = j.rotate()
+        # The second marker carries the CUMULATIVE drop set — one line
+        # replaces all rotation history, not a chain of markers.
+        assert marker["rotations"] == 2
+        assert marker["finished_rids"] == [0, 1]
+        state = journal_lib.load(j.path)
+        assert state.compacted_rids == {0, 1} and state.rotations == 2
+        assert state.known_rids == {0, 1, 2} and state.next_rid == 3
+
+    def test_max_bytes_triggers_rotation_on_flush(self, tmp_path):
+        j = RequestJournal(tmp_path, fsync=False, max_bytes=400)
+        for rid in range(12):
+            r = Request(prompt=[rid, rid + 1], max_new_tokens=1, rid=rid)
+            j.record_submit(r)
+            j.record_token(rid, 7)
+            r.status = DONE
+            r.finish_reason = "length"
+            j.record_finish(r)
+            j.flush()
+        state = journal_lib.load(j.path)
+        assert state.rotations >= 1
+        assert state.known_rids == set(range(12))
+        assert state.next_rid == 12
+        # Steady state: the file never grows past threshold + one flush.
+        assert j.path.stat().st_size < 1200
+
+    def test_torn_line_after_rotation_still_tolerated(self, tmp_path):
+        j = self._journal_with_mixed_state(tmp_path)
+        j.rotate()
+        with open(j.path, "a") as fh:
+            fh.write('{"rec": "token", "rid": 1, "t"')  # writer died here
+        state = journal_lib.load(j.path)
+        assert state.compacted_rids == {0}
+        assert state.requests[1].tokens == [9]
+
+    def test_replay_parity_with_rotation_armed(self, tmp_path, monkeypatch):
+        model = _lm(depth=1)
+        workload = _workload(6, max_new=6)
+        baseline = ServeEngine(model, max_batch=4, max_len=32)
+        want = {}
+        for w in workload:
+            r = baseline.submit(w["prompt"],
+                                max_new_tokens=w["max_new_tokens"])
+            want[r.rid] = r
+        baseline.run_until_idle()
+
+        # The env-tuned threshold is what the engine's directory branch
+        # (and the jobs worker) picks up.
+        monkeypatch.setenv(journal_lib.JOURNAL_MAX_BYTES_ENV, "300")
+        engine = ServeEngine(model, max_batch=4, max_len=32,
+                             journal=tmp_path / "j")
+        assert engine.journal.max_bytes == 300
+        got = {}
+        for w in workload:
+            r = engine.submit(w["prompt"],
+                              max_new_tokens=w["max_new_tokens"])
+            got[r.rid] = r
+        engine.run_until_idle()
+        engine.close()
+        for rid, r in want.items():
+            assert got[rid].generated == r.generated
+
+        state = journal_lib.load(tmp_path / "j" / journal_lib.JOURNAL_NAME)
+        assert state.rotations >= 1, "anti-vacuity: no rotation happened"
+        # A restart on the compacted journal: every rid is still known, so
+        # recovery resubmits nothing and new rids continue past the old.
+        revived = ServeEngine(model, max_batch=4, max_len=32,
+                              journal=tmp_path / "j")
+        assert revived.known_rids == set(range(6))
+        assert revived.scheduler.idle()
+        fresh = revived.submit([1, 2, 3], max_new_tokens=2)
+        assert fresh.rid == 6
+        revived.close()
+
+    def test_max_bytes_env_parsing(self, monkeypatch):
+        monkeypatch.delenv(journal_lib.JOURNAL_MAX_BYTES_ENV, raising=False)
+        assert journal_lib.journal_max_bytes_from_env() is None
+        for bad in ("", "0", "nope"):
+            monkeypatch.setenv(journal_lib.JOURNAL_MAX_BYTES_ENV, bad)
+            assert journal_lib.journal_max_bytes_from_env() is None
+        monkeypatch.setenv(journal_lib.JOURNAL_MAX_BYTES_ENV, "65536")
+        assert journal_lib.journal_max_bytes_from_env() == 65536
+
+
 class TestServeFaultGrammar:
     def test_req_target_parsing(self):
         plan = FaultPlan.parse("engine-crash@req3")

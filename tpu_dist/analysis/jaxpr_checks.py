@@ -641,6 +641,62 @@ def _trace_integrity_audit_checksum():
         return jax.make_jaxpr(fn)(*leaves)
 
 
+def _trace_jobs_runtime_train_step():
+    """The trainer step built INSIDE a multi-tenant job scope
+    (jobs/runtime.py): same probe model as ``training.trainer.train_step``
+    but with the strategy and program acquisition flowing through a
+    :class:`~tpu_dist.jobs.runtime.MeshRuntime` submesh lease. Pins the
+    solo no-op contract from the program side: packing a job onto a
+    1-slice pool must change NOTHING — same jaxpr family, zero added
+    collectives, zero added comm bytes vs the solo baseline."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.jobs.runtime import MeshRuntime, job_scope
+    from tpu_dist.jobs.spec import JobSpec
+    from tpu_dist.models import Dense, Sequential
+    from tpu_dist.training.trainer import Trainer
+
+    runtime = MeshRuntime(jax.devices()[:1])
+    spec = JobSpec(name="shardcheck-job", kind="train", devices=1)
+    with job_scope(runtime, spec):
+        model = Sequential([Dense(4)], input_shape=(4,),
+                           name="shardcheck_probe")
+        model.compile(optimizer="sgd", loss="mse")
+        trainer = Trainer(model)
+        step = trainer._pure_step()
+        trainer.ensure_variables()
+        state = trainer.train_state()
+        x = np.zeros((8, 4), np.float32)
+        y = np.zeros((8, 4), np.float32)
+        rng = jax.random.PRNGKey(0)
+        return jax.make_jaxpr(step)(*state, x, y, rng)
+
+
+def _trace_jobs_runtime_decode_step():
+    """``serve.kv_cache.decode_step`` built inside a multi-tenant job
+    scope — the packed serving counterpart of ``serve.decode_step``. Pins
+    that a serve job on a leased submesh slice decodes with the identical
+    collective-free program a solo engine compiles."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.jobs.runtime import MeshRuntime, job_scope
+    from tpu_dist.jobs.spec import JobSpec
+    from tpu_dist.serve import kv_cache
+
+    runtime = MeshRuntime(jax.devices()[:1])
+    spec = JobSpec(name="shardcheck-serve-job", kind="serve", devices=1)
+    with job_scope(runtime, spec):
+        plan, params, cache = _serve_probe()
+        tokens = jnp.zeros((4,), jnp.int32)
+        lengths = jnp.ones((4,), jnp.int32)
+        return jax.make_jaxpr(
+            lambda p, c, t, ln: kv_cache.decode_step(plan, p, c, t, ln,
+                                                     bucket=4))(
+            params, cache, tokens, lengths)
+
+
 ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
@@ -655,6 +711,8 @@ ENTRY_POINTS = {
     "serve.decode_step": _trace_serve_decode,
     "training.integrity.health_step": _trace_integrity_health_step,
     "training.integrity.audit_checksum": _trace_integrity_audit_checksum,
+    "jobs.runtime.train_step": _trace_jobs_runtime_train_step,
+    "jobs.runtime.decode_step": _trace_jobs_runtime_decode_step,
 }
 
 #: Argument positions each entry point's production caller donates

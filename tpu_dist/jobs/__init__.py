@@ -1,0 +1,41 @@
+"""tpu_dist.jobs — multi-tenant job runtime on one device pool.
+
+The paper's subject assumes one job owns the cluster; this package packs
+N training/serving jobs onto one pool with nothing shared but devices:
+
+* :class:`~tpu_dist.jobs.spec.JobSpec` declares one job (kind, submesh
+  request, priority, workload budget); its
+  :class:`~tpu_dist.jobs.spec.JobNamespace` derives every per-job
+  resource — RNG stream (job-name fold-in), checkpoint directory,
+  ``job.<name>.*`` metric prefix, resilience event log — from the spec
+  alone, so a job's outputs are bit-identical solo or packed.
+* :class:`~tpu_dist.jobs.runtime.MeshRuntime` owns the pool and the
+  compiled-program cache; jobs lease static submesh slices
+  (divisor-validated, like reshape-on-restore) through
+  :func:`~tpu_dist.jobs.runtime.job_scope`, and Trainer/ServeEngine
+  acquire mesh + programs through it (a no-op for solo runs).
+* :class:`~tpu_dist.jobs.scheduler.PackingScheduler` admits by priority
+  (FIFO within, with backfilling); :class:`~tpu_dist.jobs.scheduler.JobPool`
+  runs each admitted job as its own supervised worker gang — per-job
+  fault domains, so ``job_kill@jobN`` restarts only job N and the
+  blast-radius chaos gate holds neighbors to exact solo parity.
+
+``python -m tpu_dist.jobs --bench`` packs the seeded demo mix and reports
+per-job throughput + makespan vs serial (``BENCH_JOBS.json``);
+``--chaos`` runs the gated multi-job fault suite.
+"""
+
+from tpu_dist.jobs.runtime import (JobContext, MeshRuntime, SubmeshLease,
+                                   current_job, job_scope)
+from tpu_dist.jobs.scheduler import (DONE, FAILED, QUEUED, RUNNING, JobPool,
+                                     JobRecord, PackingScheduler)
+from tpu_dist.jobs.spec import (JOB_ROOT_ENV, JOB_SPEC_ENV, JobNamespace,
+                                JobSpec, derive_job_seed)
+
+__all__ = [
+    "JobSpec", "JobNamespace", "derive_job_seed",
+    "JOB_SPEC_ENV", "JOB_ROOT_ENV",
+    "MeshRuntime", "SubmeshLease", "JobContext", "current_job", "job_scope",
+    "PackingScheduler", "JobPool", "JobRecord",
+    "QUEUED", "RUNNING", "DONE", "FAILED",
+]

@@ -1,0 +1,5 @@
+import sys
+
+from tpu_dist.jobs.cli import main
+
+sys.exit(main())

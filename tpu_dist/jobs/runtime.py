@@ -1,0 +1,247 @@
+"""MeshRuntime: the shared device pool + compiled-program cache jobs run on.
+
+The refactor forcing-function the ROADMAP names: ``Trainer`` and
+``ServeEngine`` stop *owning* their mesh and compiled programs and instead
+*acquire* them through one runtime, so N jobs can share a pool without
+sharing anything else. The runtime does three things:
+
+* **owns the device pool** — the full ``jax.devices()`` list (or an
+  explicit subset, or a *virtual* pool of ``int`` slots for the
+  subprocess-packed JobPool, where each job's gang forces its own device
+  count and the pool only does the arithmetic);
+* **partitions it into submesh slices** — a job's ``devices`` request is
+  leased as one aligned, contiguous block. The partition is static and
+  divisor-validated exactly like reshape-on-restore: a request that does
+  not divide the pool is a loud error at submit time, never a silent
+  fragment, so every slice boundary is also a legal mesh boundary;
+* **owns the compiled-program cache** — jobs' compiled steps live in
+  ``runtime.cached(key, builder)`` instead of on the Trainer/Engine
+  instance, which makes the pool's program population inspectable
+  (:meth:`MeshRuntime.program_keys`) and gives sequential jobs landing on
+  the same slice a reuse point. Keys carry the owning model's identity,
+  so two jobs never execute each other's closures.
+
+**Solo no-op contract** (pinned by the ``jobs.runtime.*`` analysis entry
+points and the unchanged trainer/serve cost baselines): outside a
+:func:`job_scope`, ``current_job()`` is None and Trainer/ServeEngine take
+exactly their pre-existing path — same strategy acquisition, same
+instance-local caches, same jaxpr, bit for bit.
+
+A :func:`job_scope` composes the whole namespace: it leases the slice,
+builds the submesh strategy (``MirroredStrategy`` over the leased devices
+only), pushes a :class:`JobContext` onto a thread-local stack, and enters
+the strategy scope — so everything the job constructs inside (models,
+trainers, engines) lands on its own slice without a single call-site
+changing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Sequence, Union
+
+from tpu_dist.jobs.spec import JobNamespace, JobSpec
+
+
+class SubmeshLease:
+    """One aligned, contiguous slice of the pool, held by one job."""
+
+    def __init__(self, runtime: "MeshRuntime", start: int, size: int):
+        self.runtime = runtime
+        self.start = start
+        self.size = size
+        self.released = False
+
+    @property
+    def devices(self) -> Optional[tuple]:
+        """The leased device objects, or None on a virtual pool."""
+        if self.runtime.devices is None:
+            return None
+        return self.runtime.devices[self.start:self.start + self.size]
+
+    def strategy(self):
+        """The submesh strategy: data-parallel over the leased devices
+        only. On a virtual pool the lease has no device objects to build
+        a mesh from — the job's own worker process does that."""
+        if self.devices is None:
+            raise RuntimeError(
+                "virtual-pool leases carry no devices; the job's worker "
+                "gang builds its own mesh from its forced device count")
+        from tpu_dist.parallel.strategy import MirroredStrategy
+
+        return MirroredStrategy(devices=list(self.devices))
+
+    def release(self) -> None:
+        self.runtime.release(self)
+
+    def __repr__(self):
+        return (f"SubmeshLease([{self.start}:{self.start + self.size}] "
+                f"of {self.runtime.pool_size})")
+
+
+class MeshRuntime:
+    """The shared pool: submesh leasing + the compiled-program cache.
+
+    Args:
+      devices: ``None`` = every local jax device; a sequence = an explicit
+        pool; an ``int`` = a *virtual* pool of that many slots (no device
+        objects — the JobPool's subprocess mode, where each job's gang
+        forces its own ``--xla_force_host_platform_device_count``).
+    """
+
+    def __init__(self, devices: Union[None, int, Sequence] = None):
+        if devices is None:
+            import jax
+
+            devices = tuple(jax.devices())
+        if isinstance(devices, int):
+            if devices < 1:
+                raise ValueError(f"pool size must be >= 1, got {devices}")
+            self.devices: Optional[tuple] = None
+            self.pool_size = devices
+        else:
+            self.devices = tuple(devices)
+            if not self.devices:
+                raise ValueError("device pool must not be empty")
+            self.pool_size = len(self.devices)
+        self._lock = threading.Lock()
+        self._held: dict[int, SubmeshLease] = {}   # start index -> lease
+        self._programs: dict = {}
+        self._program_hits = 0
+
+    # -- partition arithmetic ------------------------------------------------
+
+    def validate_request(self, n: int) -> int:
+        """Divisor-validate a submesh request (the reshape-on-restore
+        rule: slices must tile the pool exactly)."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"job device request must be >= 1, got {n}")
+        if n > self.pool_size:
+            raise ValueError(
+                f"job device request {n} exceeds the pool of "
+                f"{self.pool_size} device(s)")
+        if self.pool_size % n != 0:
+            divisors = [d for d in range(1, self.pool_size + 1)
+                        if self.pool_size % d == 0]
+            raise ValueError(
+                f"job device request {n} does not divide the pool of "
+                f"{self.pool_size} device(s); submesh packing is a static "
+                f"partition — request one of {divisors}")
+        return n
+
+    def free_devices(self) -> int:
+        with self._lock:
+            return self.pool_size - sum(l.size for l in self._held.values())
+
+    def try_acquire(self, n: int) -> Optional[SubmeshLease]:
+        """Lease the first free aligned block of ``n`` devices, or None
+        when every fitting slice is held (the scheduler then queues)."""
+        n = self.validate_request(n)
+        with self._lock:
+            for start in range(0, self.pool_size, n):
+                if all(not (h <= start < h + lease.size)
+                       and not (start <= h < start + n)
+                       for h, lease in self._held.items()):
+                    lease = SubmeshLease(self, start, n)
+                    self._held[start] = lease
+                    return lease
+        return None
+
+    def acquire(self, n: int) -> SubmeshLease:
+        lease = self.try_acquire(n)
+        if lease is None:
+            raise RuntimeError(
+                f"no free submesh slice of {n} device(s) in a pool of "
+                f"{self.pool_size} ({self.free_devices()} free, "
+                f"fragmented across held slices)")
+        return lease
+
+    def release(self, lease: SubmeshLease) -> None:
+        with self._lock:
+            if lease.released or self._held.get(lease.start) is not lease:
+                raise RuntimeError(f"double release of {lease!r}")
+            del self._held[lease.start]
+            lease.released = True
+
+    # -- compiled-program cache ----------------------------------------------
+
+    def cached(self, key, builder):
+        """The pool-owned compiled-program cache: return the program under
+        ``key``, building (and caching) it on first use. Keys must carry
+        the owning model's identity — the runtime shares storage, never
+        closures."""
+        with self._lock:
+            if key in self._programs:
+                self._program_hits += 1
+                return self._programs[key]
+        program = builder()   # build outside the lock: tracing can re-enter
+        with self._lock:
+            return self._programs.setdefault(key, program)
+
+    def program_keys(self) -> list:
+        with self._lock:
+            return sorted(self._programs, key=repr)
+
+    @property
+    def program_hits(self) -> int:
+        return self._program_hits
+
+
+class JobContext:
+    """Everything a job's in-process run sees: spec, namespace, lease,
+    submesh strategy, and the runtime whose cache its programs live in."""
+
+    def __init__(self, *, spec: JobSpec, namespace: JobNamespace,
+                 runtime: MeshRuntime, lease: SubmeshLease, strategy):
+        self.spec = spec
+        self.namespace = namespace
+        self.runtime = runtime
+        self.lease = lease
+        self.strategy = strategy
+
+    def program_key(self, *parts) -> tuple:
+        """A cache key scoped to this job and its model identity."""
+        return (self.spec.name, *parts)
+
+
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def current_job() -> Optional[JobContext]:
+    """The innermost active job context on this thread, or None — the
+    solo-run fast path every Trainer/ServeEngine constructor checks."""
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def job_scope(runtime: MeshRuntime, spec: JobSpec, *,
+              root: Optional[str] = None):
+    """Place ``spec`` onto ``runtime``: lease its submesh slice, enter its
+    strategy scope, and expose the :class:`JobContext` to everything
+    constructed inside. The lease is released on exit — completion or
+    failure — so the slice always returns to the pool."""
+    lease = runtime.acquire(spec.devices)
+    try:
+        strategy = lease.strategy()
+        ctx = JobContext(spec=spec, namespace=JobNamespace(spec, root),
+                         runtime=runtime, lease=lease, strategy=strategy)
+        _stack().append(ctx)
+        try:
+            with strategy.scope():
+                yield ctx
+        finally:
+            popped = _stack().pop()
+            assert popped is ctx, "job_scope stack corrupted"
+    finally:
+        if not lease.released:
+            lease.release()

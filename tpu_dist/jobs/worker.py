@@ -1,0 +1,205 @@
+"""Job worker: the supervised entry point one packed job's gang runs.
+
+Launched by the :class:`~tpu_dist.jobs.scheduler.JobPool` as ``python -m
+tpu_dist.jobs.worker`` with the spec in ``$TPU_DIST_JOB_SPEC``; wrapped in
+:func:`~tpu_dist.resilience.entrypoints.run_entry` so every job worker
+speaks the full resilience protocol for free — SIGTERM drain, protocol
+exit codes, the ``RESULT:{...}`` line its pool parses.
+
+Both built-in workloads are **deterministic functions of the JobSpec
+alone**: the dataset/request stream and every RNG key derive from the
+job-name fold-in seed (:func:`~tpu_dist.jobs.spec.derive_job_seed`), the
+global batch is fixed, and losses are insensitive to the leased device
+count — so a job's losses/tokens are bit-identical run solo or packed,
+across restarts, and across slice placements. That determinism is what
+the isolation and blast-radius gates compare against.
+
+:func:`run_inline` is the in-process twin: the same workload placed
+through :func:`~tpu_dist.jobs.runtime.job_scope` onto a real
+:class:`~tpu_dist.jobs.runtime.MeshRuntime` submesh slice — the path the
+8-virtual-device tier-1 tests and the ``jobs.runtime.*`` analysis entry
+points drive.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from tpu_dist.jobs.spec import JOB_ROOT_ENV, JobNamespace, JobSpec
+
+
+def _job_dataset(spec: JobSpec, seed: int):
+    """Synthetic regression data, cardinality == steps_per_epoch (the
+    epoch-replay determinism property demo_train relies on)."""
+    import numpy as np
+
+    from tpu_dist.data.pipeline import Dataset
+
+    rng = np.random.RandomState(seed)
+    n = spec.batch * spec.steps_per_epoch
+    x = rng.rand(n, 8).astype(np.float32)
+    y = rng.rand(n, 4).astype(np.float32)
+    return Dataset.from_tensor_slices((x, y)).batch(spec.batch)
+
+
+def _build_train_model(spec: JobSpec):
+    from tpu_dist.models import Dense, Sequential
+
+    model = Sequential([Dense(16, activation="relu"), Dense(4)],
+                       input_shape=(8,), name=f"job_{spec.name}")
+    model.compile(optimizer="sgd", loss="mse")
+    return model
+
+
+def _train_result(spec: JobSpec, ns: JobNamespace, history,
+                  wall_s: float) -> dict:
+    losses = [round(float(l), 10) for l in history.history.get("loss", [])]
+    steps = spec.total_steps
+    return {
+        "job": spec.name, "kind": "train",
+        "final_loss": losses[-1] if losses else None,
+        "losses": losses,
+        "epochs_run": len(losses),
+        "steps": steps,
+        "wall_s": round(wall_s, 4),
+        "metrics": {
+            ns.metric("steps_per_s"): (round(steps / wall_s, 4)
+                                       if wall_s > 0 else None),
+            ns.metric("final_loss"): losses[-1] if losses else None,
+        },
+    }
+
+
+def _run_train(spec: JobSpec, ns: JobNamespace,
+               checkpoint_dir: Optional[str]) -> dict:
+    """The train workload; strategy comes from the ambient scope (solo
+    default, a job_scope submesh, or the gang's own mirrored mesh)."""
+    model = _build_train_model(spec)
+    ds = _job_dataset(spec, ns.seed)
+    t0 = time.monotonic()
+    history = model.fit(ds, epochs=spec.epochs,
+                        steps_per_epoch=spec.steps_per_epoch, verbose=0,
+                        seed=ns.seed, checkpoint_dir=checkpoint_dir)
+    return _train_result(spec, ns, history, time.monotonic() - t0)
+
+
+def _serve_requests(spec: JobSpec, seed: int, vocab: int,
+                    max_len: int) -> list[dict]:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(spec.requests):
+        plen = int(rng.integers(2, max(3, max_len // 4)))
+        out.append({
+            "prompt": rng.integers(0, vocab, size=plen).tolist(),
+            "max_new_tokens": spec.max_new,
+        })
+    return out
+
+
+def _run_serve(spec: JobSpec, ns: JobNamespace,
+               journal_dir: Optional[str]) -> dict:
+    """The serve workload: a tiny transformer LM, greedy continuous
+    batching over a seeded request stream; token streams are the parity
+    payload (greedy decoding is bit-deterministic)."""
+    from tpu_dist.models.transformer import build_transformer_lm
+    from tpu_dist.serve.engine import ServeEngine
+
+    vocab, max_len = 32, 32
+    model = build_transformer_lm(vocab, max_len, d_model=16, depth=1,
+                                 num_heads=2)
+    engine = ServeEngine(model, max_batch=min(4, spec.requests),
+                         max_len=max_len, temperature=0.0, seed=ns.seed,
+                         journal=journal_dir)
+    t0 = time.monotonic()
+    for i, req in enumerate(_serve_requests(spec, ns.seed, vocab, max_len)):
+        # Paced arrivals: hold request i to its arrival time, draining the
+        # engine while waiting. Per-request greedy decode is independent
+        # of batch composition, so pacing changes wall time only — never
+        # the token streams the parity gates compare.
+        target = t0 + i * spec.arrival_s
+        while True:
+            engine.run_until_idle()
+            wait = target - time.monotonic()
+            if wait <= 0:
+                break
+            time.sleep(min(0.02, wait))
+        engine.submit(**req)
+    engine.run_until_idle()
+    engine.close()
+    wall_s = time.monotonic() - t0
+    streams = {str(r.rid): [int(t) for t in r.generated]
+               for r in sorted(engine.finished, key=lambda r: r.rid)}
+    tokens = sum(len(s) for s in streams.values())
+    return {
+        "job": spec.name, "kind": "serve",
+        "streams": streams,
+        "tokens": tokens,
+        "wall_s": round(wall_s, 4),
+        "metrics": {
+            ns.metric("tokens_per_s"): (round(tokens / wall_s, 4)
+                                        if wall_s > 0 else None),
+            ns.metric("tokens"): tokens,
+        },
+    }
+
+
+def job_main() -> dict:
+    """Resolve the spec from the environment and run its workload under
+    the gang's own mirrored mesh (every forced local device = the leased
+    slice, from the supervisor's ``device_schedule``)."""
+    import contextlib
+
+    import jax
+
+    spec = JobSpec.from_env()
+    if spec is None:
+        raise RuntimeError(
+            "tpu_dist.jobs.worker needs $TPU_DIST_JOB_SPEC (it is launched "
+            "by a JobPool, not by hand)")
+    ns = JobNamespace(spec, os.environ.get(JOB_ROOT_ENV))
+    scope = contextlib.nullcontext()
+    if len(jax.devices()) > 1:
+        from tpu_dist.parallel.strategy import MirroredStrategy
+
+        scope = MirroredStrategy().scope()
+    with scope:
+        if spec.kind == "train":
+            from tpu_dist.resilience.entrypoints import CHECKPOINT_DIR_ENV
+
+            return _run_train(spec, ns,
+                              os.environ.get(CHECKPOINT_DIR_ENV) or None)
+        from tpu_dist.serve.journal import journal_dir_from_env
+
+        return _run_serve(spec, ns, journal_dir_from_env())
+
+
+def run_inline(runtime, spec: JobSpec, *, root: Optional[str] = None) -> dict:
+    """The same workload, in-process, placed as a submesh slice of
+    ``runtime`` through :func:`~tpu_dist.jobs.runtime.job_scope` — the
+    MeshRuntime acquisition path the Trainer/ServeEngine refactor exists
+    for. Checkpoints/journals go to the namespace when ``root`` is set."""
+    from tpu_dist.jobs.runtime import job_scope
+
+    with job_scope(runtime, spec, root=root) as ctx:
+        ns = ctx.namespace
+        if spec.kind == "train":
+            ckpt = str(ns.checkpoint_dir) if root is not None else None
+            return _run_train(spec, ns, ckpt)
+        journal = str(ns.journal_dir) if root is not None else None
+        return _run_serve(spec, ns, journal)
+
+
+if __name__ == "__main__":
+    import sys
+
+    # Same delegation as resilience.entrypoints: under ``python -m`` this
+    # file is a SECOND module object; run the canonical instance's main so
+    # anything imported from tpu_dist.jobs.worker sees one module, not two.
+    from tpu_dist.jobs import worker as _canonical
+    from tpu_dist.resilience.entrypoints import run_entry
+
+    sys.exit(run_entry(_canonical.job_main))

@@ -21,6 +21,9 @@ grammar used on the CLI::
     engine_crash@req4              # kill the serve engine at the 4th completion
     decode_stall@req2:2s           # hang a decode step 2 s mid-serve
     request_storm@req0:x400        # 400-request burst at submission 0
+    job_kill@job1                  # kill job 1's worker at its step 1
+    job_kill@job1:abort            # same, exiting EXIT_JOB_ABORT (abandon)
+    job_hang@job0:5s:step2         # hang job 0's worker 5 s at its step 2
 
 Multiple specs join with commas. Determinism is the design center: a fault
 fires at exactly one (rank, attempt, step/epoch) coordinate, so a chaos run
@@ -86,6 +89,18 @@ Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
     hang as a fault; ``request_storm`` injects ``:xM`` extra burst requests
     into the load generator at submission index N, the overload that load
     shedding must absorb.
+``job_kill`` / ``job_hang``
+    MULTI-JOB faults, addressed by the job coordinate ``@jobN`` — the
+    submission index a :class:`~tpu_dist.jobs.scheduler.JobPool` assigns
+    each packed job. The SAME plan is handed to every job's worker gang;
+    each worker arms only the faults whose job index matches its own
+    (``$TPU_DIST_JOB_INDEX``), so a fault in job N is invisible to its
+    submesh neighbors — the per-job fault-domain contract the blast-radius
+    gate pins. ``job_kill`` is ``os._exit`` at the job's own step
+    coordinate (``:stepN`` modifier, default step 1; ``:abort`` exits
+    :data:`EXIT_JOB_ABORT` so the job's supervisor abandons instead of
+    restarting); ``job_hang`` sleeps ``:Ss`` seconds there, the straggler
+    the per-job attempt deadline must absorb without touching neighbors.
 """
 
 from __future__ import annotations
@@ -101,11 +116,17 @@ from typing import Optional, Sequence
 KINDS = ("kill", "preempt", "delay_collective", "hang_collective",
          "checkpoint_fail", "kill_during_save", "slow_input",
          "nan_loss", "grad_spike", "bitflip", "corrupt_batch",
-         "engine_crash", "decode_stall", "request_storm")
+         "engine_crash", "decode_stall", "request_storm",
+         "job_kill", "job_hang")
 
 #: Fault kinds that target the SERVING path; they address the request
 #: coordinate (``@reqN``) instead of a training step/epoch.
 SERVE_KINDS = frozenset({"engine_crash", "decode_stall", "request_storm"})
+
+#: Fault kinds that target ONE JOB of a packed multi-job pool; they carry
+#: the job coordinate (``@jobN``) and are armed only by workers whose
+#: ``$TPU_DIST_JOB_INDEX`` matches — the per-job fault-domain boundary.
+JOB_KINDS = frozenset({"job_kill", "job_hang"})
 
 _ALIASES = {
     "kill-worker": "kill",
@@ -128,6 +149,8 @@ _ALIASES = {
     "engine-crash": "engine_crash",
     "decode-stall": "decode_stall",
     "request-storm": "request_storm",
+    "job-kill": "job_kill",
+    "job-hang": "job_hang",
 }
 
 #: Environment variable a worker reads its plan from (set by the CLI /
@@ -167,6 +190,15 @@ EXIT_INTEGRITY = 41
 #: replays queued/in-flight work.
 EXIT_SERVE_ABORT = 45
 
+#: Exit code of a worker whose JOB was declared dead rather than its
+#: process: the job-level runtime (or a ``job_kill@jobN:abort`` chaos
+#: fault standing in for it) decided a restart cannot help THIS job —
+#: bad spec, poisoned data, exhausted budget. The job's own supervisor
+#: lists it in ``no_restart_exits`` and the packing scheduler marks the
+#: job ``failed`` (classification ``job_abort``) while its submesh slice
+#: is released to the next queued job; neighbors never notice.
+EXIT_JOB_ABORT = 47
+
 #: Central protocol-exit registry: every NONZERO exit code the resilience
 #: layer assigns a meaning to, with the classification name
 #: ``Supervisor.classify_exit`` reports. 0 ("ok"), negative codes
@@ -180,6 +212,7 @@ _PROTOCOL_EXITS = (
     (EXIT_INTEGRITY, "integrity_abort"),
     (EXIT_FAULT_KILL, "fault_kill"),
     (EXIT_SERVE_ABORT, "serve_abort"),
+    (EXIT_JOB_ABORT, "job_abort"),
 )
 
 #: code -> classification name, derived from :data:`_PROTOCOL_EXITS`.
@@ -208,7 +241,25 @@ def classify_exit_code(code: int) -> str:
 #: unsupervised run eventually unwedges instead of leaking a process forever.
 HANG_SECONDS = 3600.0
 
-_TARGET_RE = re.compile(r"^(step|epoch|req)(\d+)$")
+_TARGET_RE = re.compile(r"^(step|epoch|req|job)(\d+)$")
+
+#: Environment variable carrying a packed job's submission index into its
+#: worker gang (set by the JobPool's per-job supervisor); unset outside a
+#: multi-job run. Lives here — not in tpu_dist.jobs — so the injector can
+#: filter job-coordinate faults without importing the jobs subsystem.
+JOB_INDEX_ENV = "TPU_DIST_JOB_INDEX"
+
+
+def current_job_index() -> Optional[int]:
+    """This process's packed-job submission index, or None outside a
+    multi-job pool (or when the env var is malformed)."""
+    raw = os.environ.get(JOB_INDEX_ENV)
+    if raw is None or not raw.strip():
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -220,6 +271,7 @@ class FaultSpec:
     step: Optional[int] = None      # global step (epoch * steps_per_epoch + i)
     epoch: Optional[int] = None
     req: Optional[int] = None       # serve kinds: request coordinate
+    job: Optional[int] = None       # job kinds: packed-job submission index
     rank: int = 0
     attempt: Optional[int] = 0      # None = every restart attempt
     seconds: float = 1.0            # delay/slow kinds
@@ -240,8 +292,22 @@ class FaultSpec:
             raise ValueError(
                 f"fault {self.kind!r} is not a serve kind; @reqN targets "
                 f"only {sorted(SERVE_KINDS)}")
+        elif self.kind in JOB_KINDS:
+            if self.job is None:
+                raise ValueError(
+                    f"job fault {self.kind!r} needs a job coordinate "
+                    f"(@jobN), got step={self.step} epoch={self.epoch}")
+            if self.step is None:
+                # Fire at the job's first step boundary unless :stepN says
+                # otherwise (frozen dataclass: object.__setattr__ is the
+                # sanctioned __post_init__ escape hatch).
+                object.__setattr__(self, "step", 1)
         elif self.step is None and self.epoch is None:
             raise ValueError(f"fault {self.kind!r} needs a step or epoch")
+        if self.job is not None and self.kind not in JOB_KINDS:
+            raise ValueError(
+                f"fault {self.kind!r} is not a job kind; @jobN targets "
+                f"only {sorted(JOB_KINDS)}")
         if self.kind == "checkpoint_fail" and self.mode not in (
                 "transient", "truncate"):
             raise ValueError(
@@ -255,6 +321,15 @@ class FaultSpec:
     def matches_process(self, rank: int, attempt: int) -> bool:
         return rank == self.rank and (
             self.attempt is None or attempt == self.attempt)
+
+    def matches_job(self, job_index: Optional[int]) -> bool:
+        """Job-domain filter: a fault without a job coordinate applies
+        everywhere; one WITH a coordinate applies only inside the worker
+        gang whose ``$TPU_DIST_JOB_INDEX`` matches. A job-coordinate fault
+        reaching a process outside any pool (``job_index is None``) does
+        NOT arm — a stray plan must never fire in a solo run."""
+        return self.job is None or (job_index is not None
+                                    and job_index == self.job)
 
     def due_at_step(self, global_step: int) -> bool:
         """Step-triggered kinds: due once the global step reaches the
@@ -362,6 +437,12 @@ def _parse_compact(spec: str) -> FaultSpec:
             kwargs["rank"] = int(mod[4:])
         elif mod.startswith("attempt") and mod[7:].isdigit():
             kwargs["attempt"] = int(mod[7:])
+        elif mod.startswith("step") and mod[4:].isdigit():
+            # Job kinds: the in-job step the fault fires at (the @target
+            # slot is taken by the job coordinate).
+            kwargs["step"] = int(mod[4:])
+        elif mod == "abort":
+            kwargs["exit_code"] = EXIT_JOB_ABORT
         elif mod == "always":
             kwargs["attempt"] = None
         elif mod.startswith("x") and mod[1:].isdigit():
@@ -387,7 +468,8 @@ def describe(plan: FaultPlan) -> Sequence[str]:
     """Human-readable one-liners, one per fault (CLI/report rendering)."""
     out = []
     for f in plan.faults:
-        where = (f"req {f.req}" if f.req is not None
+        where = (f"job {f.job} step {f.step}" if f.job is not None
+                 else f"req {f.req}" if f.req is not None
                  else f"step {f.step}" if f.step is not None
                  else f"epoch {f.epoch}")
         when = ("every attempt" if f.attempt is None

@@ -45,6 +45,7 @@ import time
 from typing import Optional, Sequence
 
 from tpu_dist.resilience import events
+from tpu_dist.resilience import faults as faults_mod
 from tpu_dist.resilience.faults import (FaultPlan, FaultSpec, HANG_SECONDS)
 from tpu_dist.training.callbacks import Callback
 
@@ -159,6 +160,20 @@ class FaultInjector(Callback):
                 continue
             if f.kind == "kill":
                 self._fire_kill(i, f, at=f"step {gstep}")
+            elif f.kind == "job_kill":
+                # Same hard death as ``kill``, but scoped to ONE packed
+                # job: maybe_injector_from_env only arms it in the gang
+                # whose $TPU_DIST_JOB_INDEX matches the @jobN coordinate,
+                # so neighbors on the other submesh slices never see it.
+                self._fire_kill(i, f, at=f"job {f.job} step {gstep}",
+                                kind="job_kill")
+            elif f.kind == "job_hang":
+                self._remaining[i] -= 1
+                self._log("fault_fired", kind="job_hang", job=f.job,
+                          step=gstep, seconds=f.seconds)
+                logger.warning("fault injection: hanging job %s worker "
+                               "%.1fs at step %d", f.job, f.seconds, gstep)
+                time.sleep(f.seconds)
             elif f.kind == "preempt":
                 self._fire_preempt(i, f, at=f"step {gstep}")
             elif f.kind == "slow_input":
@@ -184,9 +199,10 @@ class FaultInjector(Callback):
                                "replica %d at step %d", info["bit"],
                                info["leaf"], info["replica"], gstep)
 
-    def _fire_kill(self, i: int, f: FaultSpec, *, at: str) -> None:
+    def _fire_kill(self, i: int, f: FaultSpec, *, at: str,
+                   kind: str = "kill") -> None:
         self._remaining[i] -= 1
-        self._log("fault_fired", kind="kill", at=at, exit_code=f.exit_code)
+        self._log("fault_fired", kind=kind, at=at, exit_code=f.exit_code)
         logger.warning("fault injection: killing process at %s "
                        "(exit %d)", at, f.exit_code)
         os._exit(f.exit_code)
@@ -344,6 +360,12 @@ def maybe_injector_from_env(*, steps_per_epoch: int,
     if attempt is None:
         attempt = events.current_attempt()
     mine = plan.for_process(rank, attempt)
+    # Job-domain filter: faults carrying a @jobN coordinate arm only in
+    # the worker gang whose $TPU_DIST_JOB_INDEX matches — the same plan is
+    # broadcast to every job of a packed pool, and this line is what keeps
+    # job N's chaos out of its submesh neighbors.
+    job_index = faults_mod.current_job_index()
+    mine = [f for f in mine if f.matches_job(job_index)]
     import jax
 
     if jax.process_count() == 1:

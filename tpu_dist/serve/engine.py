@@ -43,7 +43,9 @@ blocking the serving loop forever.
 from __future__ import annotations
 
 import functools
+import itertools
 import logging
+import sys
 import threading
 import time
 from typing import Optional, Sequence
@@ -92,6 +94,20 @@ def _pad_to_pow2(n: int, *, lo: int = _MIN_PROMPT_PAD, hi: int) -> int:
     while p < n:
         p <<= 1
     return min(p, hi)
+
+
+def _current_job():
+    """The active multi-tenant job scope — probed through sys.modules so
+    a solo engine that never imports :mod:`tpu_dist.jobs` pays nothing,
+    not even the import (the jobs runtime's solo no-op contract)."""
+    mod = sys.modules.get("tpu_dist.jobs.runtime")
+    return mod.current_job() if mod is not None else None
+
+
+#: Monotonic engine generation counter — keys pool-cached decode/prefill
+#: programs to one engine instance (its plan, donation mode, and KV-cache
+#: shapes are baked into the traced closures).
+_ENGINE_SERIALS = itertools.count()
 
 
 class ServeEngine:
@@ -160,7 +176,15 @@ class ServeEngine:
         self.temperature = float(temperature)
         self.clock = clock or time.monotonic
         self._rng = np.random.default_rng(seed)
-        self.strategy = model.strategy or get_strategy()
+        # Mesh acquisition goes through the job runtime when a job scope
+        # is active: the engine serves on its job's leased submesh slice
+        # and its decode/prefill programs land in the pool-owned cache.
+        self._job = _current_job()
+        self._serial = next(_ENGINE_SERIALS)
+        if self._job is not None:
+            self.strategy = model.strategy or self._job.strategy
+        else:
+            self.strategy = model.strategy or get_strategy()
 
         variables = model.variables
         params = (variables["params"] if variables is not None
@@ -214,7 +238,10 @@ class ServeEngine:
         elif isinstance(journal, journal_lib.RequestJournal):
             self.journal = journal
         else:
-            self.journal = journal_lib.RequestJournal(journal)
+            # Directory path: the rotation threshold rides in from the
+            # environment (the supervised-worker configuration channel).
+            self.journal = journal_lib.RequestJournal(
+                journal, max_bytes=journal_lib.journal_max_bytes_from_env())
         if self.journal is not None:
             self._recover_from_journal()
         metrics.set_gauge("serve.ready", 1.0)
@@ -230,6 +257,11 @@ class ServeEngine:
         t0 = time.monotonic()
         state = journal_lib.load(self.journal.path)
         self.known_rids = state.known_rids
+        # Seed rid allocation from the full rid space — including rids a
+        # rotation compacted away, which have no request record left to
+        # bump the counter below. A fresh submit must never reuse one.
+        self.scheduler._next_rid = max(self.scheduler._next_rid,
+                                       state.next_rid)
         if not state.requests:
             return
         active, queued = state.pending()
@@ -299,20 +331,37 @@ class ServeEngine:
 
     # -- compiled-program cache ----------------------------------------------
 
+    def _acquire_program(self, kind: str, key, builder):
+        """Build — or acquire — one compiled program. Solo engines build
+        directly (the exact pre-jobs path); under an active job scope the
+        program lives in the pool's MeshRuntime cache, keyed by job,
+        model, and engine generation."""
+        if self._job is None:
+            return builder()
+        return self._job.runtime.cached(
+            self._job.program_key(self.model.name, self._serial, kind, key),
+            builder)
+
     def _decode_fn(self, bucket: int):
         fn = self._decode_fns.get(bucket)
         if fn is None:
-            fn = jax.jit(functools.partial(kv_cache.decode_step, self.plan,
-                                           bucket=bucket),
-                         donate_argnums=self._donate)
+            fn = self._acquire_program(
+                "decode", bucket,
+                lambda: jax.jit(
+                    functools.partial(kv_cache.decode_step, self.plan,
+                                      bucket=bucket),
+                    donate_argnums=self._donate))
             self._decode_fns[bucket] = fn
         return fn
 
     def _prefill_fn(self, pad_len: int):
         fn = self._prefill_fns.get(pad_len)
         if fn is None:
-            fn = jax.jit(functools.partial(kv_cache.prefill, self.plan),
-                         donate_argnums=self._donate)
+            fn = self._acquire_program(
+                "prefill", pad_len,
+                lambda: jax.jit(
+                    functools.partial(kv_cache.prefill, self.plan),
+                    donate_argnums=self._donate))
             self._prefill_fns[pad_len] = fn
         return fn
 

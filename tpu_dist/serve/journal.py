@@ -43,6 +43,11 @@ from typing import Optional
 #: directory from (set by the serve chaos driver / ServeSupervisor).
 JOURNAL_DIR_ENV = "TPU_DIST_SERVE_JOURNAL"
 
+#: Environment variable bounding the journal file size (bytes): past it,
+#: the next flush compacts the file (:meth:`RequestJournal.flush`).
+#: Unset/empty/0 = never rotate (the historical behavior).
+JOURNAL_MAX_BYTES_ENV = "TPU_DIST_SERVE_JOURNAL_MAX_BYTES"
+
 #: Journal file name inside the journal directory.
 JOURNAL_NAME = "journal.jsonl"
 
@@ -59,13 +64,24 @@ class RequestJournal:
       fsync: set False to skip the per-flush fsync (tests on tmpfs; a
         production engine keeps it on — a journal that loses its tail to
         the page cache silently re-queues shed work).
+      max_bytes: rotate (compact) the journal when a flush leaves the
+        file larger than this. Compaction drops finished requests'
+        records — their rids survive in the rotation marker, so
+        idempotent resubmission and rid allocation are unchanged — and
+        rewrites unfinished requests' submit+token trails verbatim, so a
+        crash after any number of rotations replays exactly like one
+        before the first. None (or a false-y value) = never rotate; a
+        long-lived engine's journal then grows with every token served.
     """
 
-    def __init__(self, directory: str | os.PathLike, *, fsync: bool = True):
+    def __init__(self, directory: str | os.PathLike, *, fsync: bool = True,
+                 max_bytes: Optional[int] = None):
         self.directory = pathlib.Path(directory)
         self.directory.mkdir(parents=True, exist_ok=True)
         self.path = self.directory / JOURNAL_NAME
         self.fsync = bool(fsync)
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.rotations = 0
         self._buf: list[str] = []
         self._closed = False
 
@@ -122,7 +138,53 @@ class RequestJournal:
             fh.flush()
             if self.fsync:
                 os.fsync(fh.fileno())
+        if (self.max_bytes is not None
+                and self.path.stat().st_size > self.max_bytes):
+            self.rotate()
         return n
+
+    def rotate(self) -> dict:
+        """Compact the journal in place: drop finished requests' records,
+        keep replay-marker history and every unfinished request's full
+        submit+token trail, and lead with ONE cumulative ``rotate`` marker
+        carrying the dropped rids (so ``known_rids``/``next_rid`` read
+        back exactly as before compaction). Atomic and durable the
+        checkpoint way — temp file, fsync, rename, fsync(dir) — so a
+        crash mid-rotation leaves either the old journal or the new one,
+        never a blend. Returns the rotation marker."""
+        state = load(self.path)
+        finished = sorted(state.compacted_rids
+                          | {r.rid for r in state.requests.values()
+                             if r.finished})
+        self.rotations = state.rotations + 1
+        marker = {"rec": "rotate", "rotations": self.rotations,
+                  "finished_rids": finished,
+                  "ts": round(time.time(), 6)}
+        lines = [json.dumps(marker)]
+        lines += [json.dumps(m) for m in state.replay_markers]
+        unfinished = sorted((r for r in state.requests.values()
+                             if not r.finished), key=lambda r: r.order)
+        for r in unfinished:
+            lines.append(json.dumps(
+                {"rec": "submit", "rid": r.rid, "prompt": r.prompt,
+                 "max_new_tokens": r.max_new_tokens, "eos_id": r.eos_id,
+                 "deadline_s": r.deadline_s}))
+            lines += [json.dumps({"rec": "token", "rid": r.rid, "t": t})
+                      for t in r.tokens]
+        tmp = self.path.with_name(JOURNAL_NAME + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write("\n".join(lines) + "\n")
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        if self.fsync:
+            fd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        return marker
 
     def close(self) -> None:
         if not self._closed:
@@ -180,14 +242,19 @@ class JournalState:
         self.requests: dict[int, JournaledRequest] = {}
         self.replay_markers: list[dict] = []
         self.records = 0
+        #: Finished rids whose records a rotation dropped — still "known"
+        #: (resubmission idempotency, rid allocation), just not replayable.
+        self.compacted_rids: set = set()
+        self.rotations = 0
 
     @property
     def known_rids(self) -> set:
-        return set(self.requests)
+        return set(self.requests) | self.compacted_rids
 
     @property
     def next_rid(self) -> int:
-        return max(self.requests, default=-1) + 1
+        return max(max(self.requests, default=-1),
+                   max(self.compacted_rids, default=-1)) + 1
 
     def pending(self) -> tuple[list, list]:
         """``(active, queued)`` in arrival order: active = unfinished with
@@ -239,6 +306,11 @@ def load(path: str | os.PathLike) -> JournalState:
                     jr = state.requests.get(int(rid))
                     if jr is not None:
                         jr.replays += 1
+            elif kind == "rotate":
+                state.compacted_rids |= {int(r) for r in
+                                         rec.get("finished_rids", [])}
+                state.rotations = max(state.rotations,
+                                      int(rec.get("rotations", 0)))
     return state
 
 
@@ -247,3 +319,16 @@ def journal_dir_from_env() -> Optional[str]:
     when this process serves without crash recovery."""
     d = os.environ.get(JOURNAL_DIR_ENV)
     return d if d else None
+
+
+def journal_max_bytes_from_env() -> Optional[int]:
+    """The rotation threshold from ``$TPU_DIST_SERVE_JOURNAL_MAX_BYTES``,
+    or None (never rotate) when unset, empty, zero, or unparseable."""
+    raw = os.environ.get(JOURNAL_MAX_BYTES_ENV)
+    if not raw or not raw.strip():
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None

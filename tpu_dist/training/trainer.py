@@ -32,7 +32,9 @@ shuffle) on exhaustion.
 
 from __future__ import annotations
 
+import itertools
 import logging
+import sys
 import time
 from typing import Any, Optional, Sequence
 
@@ -73,6 +75,20 @@ def jnp_stack_keys(root_key, base: int, k: int):
         base + jnp.arange(k))
 
 
+def _current_job():
+    """The active multi-tenant job scope — checked through sys.modules so
+    a solo run that never imports :mod:`tpu_dist.jobs` pays nothing, not
+    even the import (the jobs runtime's solo no-op contract)."""
+    mod = sys.modules.get("tpu_dist.jobs.runtime")
+    return mod.current_job() if mod is not None else None
+
+
+#: Monotonic Trainer generation counter — the program-cache key component
+#: that keeps one model's successive trainers (recompiles) from aliasing
+#: each other's pool-cached programs.
+_TRAINER_SERIALS = itertools.count()
+
+
 class Trainer:
     """Owns device-resident training variables and the compiled steps."""
 
@@ -80,7 +96,16 @@ class Trainer:
         from tpu_dist.parallel.strategy import get_strategy
 
         self.model = model
-        self.strategy = model.strategy or get_strategy()
+        # Mesh acquisition goes through the job runtime when a job scope
+        # is active: the strategy is the job's leased submesh slice, and
+        # compiled programs land in the pool-owned cache (_acquire_program)
+        # instead of on this instance alone.
+        self._job = _current_job()
+        self._serial = next(_TRAINER_SERIALS)
+        if self._job is not None:
+            self.strategy = model.strategy or self._job.strategy
+        else:
+            self.strategy = model.strategy or get_strategy()
         self.variables: Optional[dict] = None  # params/state/opt/metrics
         self._train_step = None
         self._eval_step = None
@@ -315,6 +340,36 @@ class Trainer:
         return (None, p_sh, rep_like(v["state"]),
                 o_sh, rep_like(v["metrics"]), rep_like(acc), rep)
 
+    def _acquire_program(self, kind: str, builder, *variant):
+        """Build — or acquire — one compiled program. Solo runs call the
+        builder directly: the exact pre-jobs path. Under an active job
+        scope the program lives in the pool's
+        :class:`~tpu_dist.jobs.runtime.MeshRuntime` cache instead, keyed
+        by job, model identity, and every trace-time dimension the
+        invalidation logic tracks (policy, device transform, class
+        weights) — so the pool owns its compiled-program population and
+        a dimension that thrashes back becomes a cache hit, not a
+        recompile."""
+        if self._job is None:
+            return builder()
+        # The serial (not id(), which the allocator reuses) keys programs
+        # to THIS trainer generation: a model recompile makes a new
+        # Trainer — and its steps bake in the new optimizer/loss, so they
+        # must never alias the old generation's cache entries.
+        key = self._job.program_key(self.model.name, self._serial,
+                                    kind, *variant)
+        return self._job.runtime.cached(key, builder)
+
+    def _train_variant(self) -> tuple:
+        cw = self._class_weight
+        return (self._built_policy,
+                self._transform_key(self._device_transform),
+                None if cw is None else tuple(sorted(cw.items())))
+
+    def _eval_variant(self) -> tuple:
+        return (self._built_policy,
+                self._transform_key(self._eval_transform))
+
     def _build_train_step(self):
         return jax.jit(
             self._pure_step(),
@@ -398,10 +453,13 @@ class Trainer:
              else max(1, int(getattr(self.model, "steps_per_execution", 1))))
         if k > 1:
             if self._multi_step is None:
-                self._multi_step = self._build_multi_step()
+                self._multi_step = self._acquire_program(
+                    "multi_step", self._build_multi_step,
+                    *self._train_variant())
             return self._multi_step
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = self._acquire_program(
+                "train_step", self._build_train_step, *self._train_variant())
         return self._train_step
 
     def train_state(self) -> tuple:
@@ -517,10 +575,12 @@ class Trainer:
         dist = self._distribute(x)
         self._sync_device_transform(dist, role="train")
         if self._train_step is None:
-            self._train_step = self._build_train_step()
+            self._train_step = self._acquire_program(
+                "train_step", self._build_train_step, *self._train_variant())
         if (getattr(self.model, "steps_per_execution", 1) > 1
                 and self._multi_step is None):
-            self._multi_step = self._build_multi_step()
+            self._multi_step = self._acquire_program(
+                "multi_step", self._build_multi_step, *self._train_variant())
         if steps_per_epoch is None:
             steps_per_epoch = self._cardinality_of(dist)
             if steps_per_epoch is None:
@@ -914,7 +974,8 @@ class Trainer:
         per-epoch validation hook of fit()."""
         self._sync_device_transform(dist, role="eval")
         if self._eval_step is None:
-            self._eval_step = self._build_eval_step()
+            self._eval_step = self._acquire_program(
+                "eval_step", self._build_eval_step, *self._eval_variant())
         v = self.variables
         metric_states = self._init_metric_states()
         loss_acc = self._init_loss_acc()
@@ -965,7 +1026,8 @@ class Trainer:
                     xb = dt(xb)
                 return model.apply(p, s, xb, training=False)[0]
 
-            self._predict_fn = jax.jit(fwd)
+            self._predict_fn = self._acquire_program(
+                "predict", lambda: jax.jit(fwd), *self._eval_variant())
         if is_array:
             batches = [np.asarray(x)]
         else:
