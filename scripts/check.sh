@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # Repo gate: shardcheck static analysis, the resilience smoke chaos run,
 # the elastic preempt+reshape chaos run, the observe telemetry smoke/bench,
-# the checkpoint stall bench, the serve load bench, then the tier-1 test
-# suite.
+# the checkpoint stall bench, the serve load bench, the step-execution
+# overlap bench, then the tier-1 test suite.
 #
 # Usage: scripts/check.sh
 #
@@ -164,6 +164,19 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.jobs --bench \
   || { echo "check.sh: jobs bench gates failed (see BENCH_JOBS.json)" >&2
        exit 1; }
 rm -rf "$jobs_bench_dir"
+
+echo "== step-bench: bucketed all-reduce + double-buffered input =="
+# Measures both overlap knobs against their default-off baselines on
+# identical seeded runs (8 virtual devices so the bucketed shard_map
+# schedule reduces over a real data axis); writes BENCH_STEP.json.
+# Gates: fused/bucketed loss parity to allclose, >= 2 bucket flushes
+# actually fired (zero buckets = vacuous), the prefetch run hit its
+# queue AND cut summed data_wait_s >= 50%, both knobs default off on a
+# fresh compile, and no schedule retraces (_cache_size() == 1).
+timeout -k 10 580 env JAX_PLATFORMS=cpu TPU_DIST_BENCH_DEVICES=8 \
+  python benchmarks/step_bench.py >/dev/null \
+  || { echo "check.sh: step bench gates failed (see BENCH_STEP.json)" >&2
+       exit 1; }
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -100,6 +100,14 @@ class TestJaxprRules:
         assert rc == 1
         assert "SC201" in _rule_ids(payload)
 
+    def test_bucket_order_divergent_fixture_flags_sc201(self, capsys,
+                                                        eight_devices):
+        # Rank-dependent bucket packing = rank-dependent launch counts.
+        rc, payload = _cli_json(
+            capsys, [str(BAD / "bucket_order_divergent.py")])
+        assert rc == 1
+        assert "SC201" in _rule_ids(payload)
+
     def test_uniform_branches_fixture_is_clean(self, capsys, eight_devices):
         rc, payload = _cli_json(
             capsys, [str(GOOD / "uniform_branches.py")])

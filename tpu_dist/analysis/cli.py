@@ -217,9 +217,13 @@ def cost_main(argv: Optional[list] = None) -> int:
         help="additional modules with a shardcheck_entry() to price "
              "alongside the built-in entry points")
     parser.add_argument(
-        "--mesh", default=None, metavar="AXIS=N[,AXIS=N...]",
+        "--mesh", default=None,
+        metavar="AXIS=N[:BW_GBPS[:LAT_US]][,...]",
         help="model the ring costs at these axis sizes (e.g. "
-             "data=8,model=4) instead of the traced mesh sizes")
+             "data=8,model=4) instead of the traced mesh sizes; an "
+             "optional per-axis link suffix (e.g. data=8:90:1.5 for "
+             "90 GB/s links with 1.5 us launch latency) feeds the step "
+             "latency estimate")
     parser.add_argument(
         "--entries", default=None, metavar="NAME[,NAME...]",
         help="restrict to these built-in entry points (default: all)")
@@ -263,8 +267,9 @@ def cost_main(argv: Optional[list] = None) -> int:
     elif args.baseline and not args.update_baseline:
         parser.error(f"no such baseline: {args.baseline}")
 
+    links = {}
     if args.mesh is not None:
-        model_mesh = costmodel.parse_mesh(args.mesh)
+        model_mesh, links = costmodel.parse_mesh_links(args.mesh)
     elif previous is not None and not args.update_baseline:
         model_mesh = dict(previous.get("mesh", {}))
     else:
@@ -287,7 +292,7 @@ def cost_main(argv: Optional[list] = None) -> int:
     traced, findings = jaxpr_checks.trace_entry_points(names)
     reports = {
         name: costmodel.analyze_jaxpr(
-            closed, entry=name, model_mesh=model_mesh)
+            closed, entry=name, model_mesh=model_mesh, links=links)
         for name, closed in traced.items()}
 
     for p in args.paths:
@@ -303,7 +308,7 @@ def cost_main(argv: Optional[list] = None) -> int:
 
                 closed = jax.make_jaxpr(fn)(*fargs)
                 reports[label] = costmodel.analyze_jaxpr(
-                    closed, entry=label, model_mesh=model_mesh)
+                    closed, entry=label, model_mesh=model_mesh, links=links)
             except Exception as e:  # noqa: BLE001 - degrade, never crash
                 findings.append(Finding(
                     "SC900", f, 1, 0,

@@ -71,17 +71,62 @@ def aval_bytes(aval) -> int:
     return int(math.prod(shape)) * int(itemsize)
 
 
-def parse_mesh(spec: str) -> dict:
-    """``"data=8,model=4"`` -> ``{"data": 8, "model": 4}``."""
+#: Per-topology link defaults the latency model falls back to when a
+#: ``--mesh`` spec names no link parameters: ICI-order per-link bandwidth
+#: and per-launch fabric latency, and one core's sustained compute rate.
+#: All three are MODEL constants — the point is relative pricing of
+#: schedules (launch count x latency vs bytes/bandwidth vs overlap), not
+#: absolute wall-clock prophecy.
+DEFAULT_LINK_BANDWIDTH_GBPS = 100.0
+DEFAULT_LINK_LATENCY_US = 1.0
+DEFAULT_COMPUTE_FLOPS_PER_S = 100e12
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkSpec:
+    """Link parameters for one mesh axis: sustained bandwidth (GB/s) and
+    per-collective-launch latency (us)."""
+
+    bandwidth_gbps: float = DEFAULT_LINK_BANDWIDTH_GBPS
+    latency_us: float = DEFAULT_LINK_LATENCY_US
+
+    @property
+    def bytes_per_s(self) -> float:
+        return self.bandwidth_gbps * 1e9
+
+    @property
+    def latency_s(self) -> float:
+        return self.latency_us * 1e-6
+
+    def to_json(self) -> dict:
+        return {"bandwidth_gbps": self.bandwidth_gbps,
+                "latency_us": self.latency_us}
+
+
+def parse_mesh_links(spec: str) -> tuple[dict, dict]:
+    """``"data=8:90:1.5,model=4"`` -> axis sizes plus per-axis link specs.
+
+    Each axis is ``AXIS=N[:BW_GBPS[:LAT_US]]`` — the optional link suffix
+    feeds the latency model (:func:`estimate_latency`); axes without one
+    get the :class:`LinkSpec` defaults. Returns ``(axes, links)`` where
+    ``links`` holds only explicitly-specified axes.
+    """
     axes: dict[str, int] = {}
+    links: dict[str, LinkSpec] = {}
     for part in spec.split(","):
         part = part.strip()
         if not part:
             continue
-        name, eq, size = part.partition("=")
+        name, eq, rest = part.partition("=")
         if not eq or not name.strip():
             raise ValueError(
                 f"bad mesh spec {part!r}; expected axis=size (e.g. data=8)")
+        fields = rest.split(":")
+        if len(fields) > 3:
+            raise ValueError(
+                f"bad mesh spec {part!r}; expected "
+                "AXIS=N[:BW_GBPS[:LAT_US]]")
+        size = fields[0]
         try:
             n = int(size)
         except ValueError:
@@ -89,8 +134,33 @@ def parse_mesh(spec: str) -> dict:
                 f"bad mesh axis size {size!r} for axis {name!r}") from None
         if n < 1:
             raise ValueError(f"mesh axis {name!r} must be >= 1, got {n}")
-        axes[name.strip()] = n
-    return axes
+        name = name.strip()
+        axes[name] = n
+        if len(fields) > 1:
+            try:
+                bw = float(fields[1])
+                lat = (float(fields[2]) if len(fields) > 2
+                       else DEFAULT_LINK_LATENCY_US)
+            except ValueError:
+                raise ValueError(
+                    f"bad link spec {rest!r} for axis {name!r}; expected "
+                    "N[:BW_GBPS[:LAT_US]]") from None
+            if bw <= 0:
+                raise ValueError(
+                    f"link bandwidth for axis {name!r} must be > 0, got "
+                    f"{bw}")
+            if lat < 0:
+                raise ValueError(
+                    f"link latency for axis {name!r} must be >= 0, got "
+                    f"{lat}")
+            links[name] = LinkSpec(bandwidth_gbps=bw, latency_us=lat)
+    return axes, links
+
+
+def parse_mesh(spec: str) -> dict:
+    """``"data=8,model=4"`` -> ``{"data": 8, "model": 4}`` (link suffixes,
+    if any, are accepted and dropped — see :func:`parse_mesh_links`)."""
+    return parse_mesh_links(spec)[0]
 
 
 def _axis_names(params: Mapping) -> tuple:
@@ -174,9 +244,10 @@ class CostReport:
     peak_hbm_bytes: int
     args: tuple  # of ArgLiveness
     mesh: dict  # modeled axis sizes actually applied
+    latency: Optional["LatencyEstimate"] = None
 
     def to_json(self) -> dict:
-        return {
+        payload = {
             "entry": self.entry,
             "total_comm_bytes": self.total_comm_bytes,
             "peak_hbm_bytes": self.peak_hbm_bytes,
@@ -184,6 +255,9 @@ class CostReport:
             "args": [dataclasses.asdict(a) for a in self.args],
             "mesh": dict(self.mesh),
         }
+        if self.latency is not None:
+            payload["latency"] = self.latency.to_json()
+        return payload
 
 
 def _sub_jaxprs(params: Mapping):
@@ -249,6 +323,137 @@ def collect_collective_costs(jaxpr, *, mesh_env: Optional[dict] = None,
                 sub, mesh_env=inner_env, model_mesh=model_mesh,
                 multiplier=inner_mult))
     return out
+
+
+def _dot_general_flops(eqn) -> int:
+    """2 * batch * lhs_free * rhs_free * contract for one dot_general."""
+    lhs = eqn.invars[0].aval
+    rhs = eqn.invars[1].aval
+    try:
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    except (KeyError, ValueError, TypeError):  # pragma: no cover
+        return 2 * max(aval_bytes(lhs), aval_bytes(rhs))
+    del rc, rb
+    lshape = tuple(getattr(lhs, "shape", ()) or ())
+    rshape = tuple(getattr(rhs, "shape", ()) or ())
+    contract = int(math.prod(lshape[d] for d in lc)) or 1
+    batch = int(math.prod(lshape[d] for d in lb)) or 1
+    lhs_free = max(int(math.prod(lshape)) // (contract * batch), 1)
+    rhs_free = max(int(math.prod(rshape)) // (contract * batch), 1)
+    return 2 * batch * lhs_free * rhs_free * contract
+
+
+def _conv_flops(eqn) -> int:
+    """2 * out_elements * (kernel_elements / out_channels) for a conv."""
+    out = eqn.outvars[0].aval if eqn.outvars else None
+    kernel = eqn.invars[1].aval if len(eqn.invars) > 1 else None
+    if out is None or kernel is None:  # pragma: no cover
+        return 0
+    out_shape = tuple(getattr(out, "shape", ()) or ())
+    k_shape = tuple(getattr(kernel, "shape", ()) or ())
+    out_elems = int(math.prod(out_shape)) or 1
+    k_elems = int(math.prod(k_shape)) or 1
+    out_ch = int(out_shape[-1]) if out_shape else 1
+    return 2 * out_elems * max(k_elems // max(out_ch, 1), 1)
+
+
+def collect_flops(jaxpr, *, multiplier: int = 1) -> int:
+    """Modeled FLOPs of one jaxpr: dot_general/conv priced exactly, every
+    other eqn one flop per output element (an elementwise floor), scan
+    bodies multiplied by their length, collectives excluded (the latency
+    model prices those over links, not cores)."""
+    from tpu_dist.analysis.jaxpr_checks import _COLLECTIVE_FRAGMENTS
+
+    core = getattr(jaxpr, "jaxpr", jaxpr)
+    total = 0
+    for eqn in core.eqns:
+        name = eqn.primitive.name
+        if _is_comm(name, ZERO_COST_FRAGMENTS + _COLLECTIVE_FRAGMENTS):
+            continue
+        inner_mult = multiplier
+        if name == "scan":
+            inner_mult = multiplier * int(eqn.params.get("length", 1))
+        subs = list(_sub_jaxprs(eqn.params))
+        if subs:
+            for _, sub in subs:
+                total += collect_flops(sub, multiplier=inner_mult)
+            continue
+        if name == "dot_general":
+            total += multiplier * _dot_general_flops(eqn)
+        elif name == "conv_general_dilated":
+            total += multiplier * _conv_flops(eqn)
+        else:
+            out_elems = sum(
+                int(math.prod(getattr(v.aval, "shape", ()) or ())) or 1
+                for v in eqn.outvars if hasattr(v, "aval"))
+            total += multiplier * out_elems
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class LatencyEstimate:
+    """Modeled per-step latency for one entry point.
+
+    The overlap model: a collective launched mid-step can run concurrently
+    with remaining compute, EXCEPT the final launch site — its result
+    gates the optimizer update, so its time is a hard tail. Everything
+    before it overlaps with up to ``compute_s`` of work; whatever does
+    not fit (comm-bound programs) spills into the tail too.
+    """
+
+    compute_s: float  # flops / flops_per_s
+    comm_s: float  # sum over launch sites of multiplier*(lat + B/bw)
+    overlapped_s: float  # comm hidden under compute
+    comm_tail_s: float  # non-overlappable remainder (>= last site)
+    step_latency_s: float  # compute_s + comm_tail_s
+    launches: int  # total collective launches (sum of multipliers)
+    flops: int
+
+    def to_json(self) -> dict:
+        return {
+            "compute_s": self.compute_s,
+            "comm_s": self.comm_s,
+            "overlapped_s": self.overlapped_s,
+            "comm_tail_s": self.comm_tail_s,
+            "step_latency_s": self.step_latency_s,
+            "launches": self.launches,
+            "flops": self.flops,
+        }
+
+
+def estimate_latency(flops: int, collectives: Iterable[CollectiveCost],
+                     *, links: Optional[Mapping] = None,
+                     flops_per_s: float = DEFAULT_COMPUTE_FLOPS_PER_S,
+                     ) -> LatencyEstimate:
+    """Price one step: compute from the flop count, comm from per-axis
+    link specs (``links`` maps axis name -> :class:`LinkSpec`; unnamed
+    axes get defaults), overlap per the :class:`LatencyEstimate` model.
+
+    Each launch site costs ``multiplier * (link_latency + bytes/bandwidth)``
+    — so a bucketed schedule pays latency once per bucket (launch-count
+    accounting) while a fused schedule pays it once, and the tradeoff
+    against overlap is visible in ``comm_tail_s``.
+    """
+    links = dict(links or {})
+    default = LinkSpec()
+    compute_s = float(flops) / float(flops_per_s)
+    site_times = []
+    launches = 0
+    for c in collectives:
+        link = links.get(c.axes[0], default) if c.axes else default
+        mult = max(int(c.multiplier), 1)
+        per_launch_bytes = c.bytes / mult
+        site_times.append(
+            mult * (link.latency_s + per_launch_bytes / link.bytes_per_s))
+        launches += mult
+    comm_s = float(sum(site_times))
+    tail_site_s = float(site_times[-1]) if site_times else 0.0
+    overlapped_s = min(comm_s - tail_site_s, compute_s)
+    comm_tail_s = comm_s - overlapped_s
+    return LatencyEstimate(
+        compute_s=compute_s, comm_s=comm_s, overlapped_s=overlapped_s,
+        comm_tail_s=comm_tail_s, step_latency_s=compute_s + comm_tail_s,
+        launches=launches, flops=int(flops))
 
 
 def _boundary_bytes(jaxpr) -> int:
@@ -342,9 +547,11 @@ def arg_liveness(jaxpr) -> list:
 
 
 def analyze_jaxpr(closed, *, entry: str,
-                  model_mesh: Optional[Mapping] = None) -> CostReport:
+                  model_mesh: Optional[Mapping] = None,
+                  links: Optional[Mapping] = None) -> CostReport:
     """The full cost-model verdict for one traced entry point."""
     colls = collect_collective_costs(closed, model_mesh=model_mesh)
+    latency = estimate_latency(collect_flops(closed), colls, links=links)
     return CostReport(
         entry=entry,
         collectives=tuple(colls),
@@ -352,6 +559,7 @@ def analyze_jaxpr(closed, *, entry: str,
         peak_hbm_bytes=peak_live_bytes(closed),
         args=tuple(arg_liveness(closed)),
         mesh=dict(model_mesh or {}),
+        latency=latency,
     )
 
 

@@ -375,6 +375,58 @@ def _trace_train_step():
     return jax.make_jaxpr(step)(*state, x, y, rng)
 
 
+def _trace_train_step_bucketed():
+    """The trainer's bucketed-reduction schedule (gradient_bucket_bytes=1
+    forces one bucket per leaf on the probe model, so every explicit
+    per-bucket psum launch site appears in the jaxpr — the schedule the
+    latency cost model prices per launch, and the program SC201 guards
+    against rank-divergent bucket order)."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.models import Dense, Sequential
+    from tpu_dist.parallel import MirroredStrategy
+    from tpu_dist.training.trainer import Trainer
+
+    model = Sequential([Dense(4)], input_shape=(4,), name="shardcheck_probe")
+    model.compile(optimizer="sgd", loss="mse", gradient_bucket_bytes=1)
+    model.strategy = MirroredStrategy()  # all 8 forced-CPU devices
+    trainer = Trainer(model)
+    trainer._sync_step_knobs()
+    step = trainer._pure_train_step()
+    trainer.ensure_variables()
+    state = trainer.train_state()
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 4), np.float32)
+    rng = jax.random.PRNGKey(0)
+    return jax.make_jaxpr(step)(*state, x, y, rng)
+
+
+def _trace_train_step_prefetch():
+    """The trainer's step with double-buffered input enabled
+    (prefetch_to_device=2). The traced program must be IDENTICAL to the
+    plain train_step — prefetch lives entirely on the host side of the
+    seam (a background device_put thread), so baselining this entry pins
+    that turning the knob on never changes the compiled step."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.models import Dense, Sequential
+    from tpu_dist.training.trainer import Trainer
+
+    model = Sequential([Dense(4)], input_shape=(4,), name="shardcheck_probe")
+    model.compile(optimizer="sgd", loss="mse", prefetch_to_device=2)
+    trainer = Trainer(model)
+    trainer._sync_step_knobs()
+    step = trainer._pure_train_step()
+    trainer.ensure_variables()
+    state = trainer.train_state()
+    x = np.zeros((8, 4), np.float32)
+    y = np.zeros((8, 4), np.float32)
+    rng = jax.random.PRNGKey(0)
+    return jax.make_jaxpr(step)(*state, x, y, rng)
+
+
 def _trace_resilience_demo_step():
     """The supervised/resumable trainer step as the resilience demo runs it
     (resilience/entrypoints.py: the reference CNN under fit(checkpoint_dir=),
@@ -701,6 +753,8 @@ ENTRY_POINTS = {
     "pipeline_parallel.gpipe_schedule": _trace_gpipe,
     "pipeline_1f1b.one_f_one_b": _trace_1f1b,
     "training.trainer.train_step": _trace_train_step,
+    "training.trainer.train_step_bucketed": _trace_train_step_bucketed,
+    "training.trainer.train_step_prefetch": _trace_train_step_prefetch,
     "resilience.entrypoints.demo_train_step": _trace_resilience_demo_step,
     "observe.demo_train_step": _trace_observe_demo_step,
     "parallel.tensor.megatron_block": _trace_megatron_block,

@@ -100,10 +100,19 @@ def _human_bytes(n: int) -> str:
     return f"{n} B"  # pragma: no cover - unreachable
 
 
+def _human_time(seconds: float) -> str:
+    if seconds < 1e-3:
+        return f"{seconds * 1e6:.1f} us"
+    if seconds < 1.0:
+        return f"{seconds * 1e3:.2f} ms"
+    return f"{seconds:.3f} s"
+
+
 def render_cost_text(reports, findings: Iterable[Finding] = (), *,
                      mesh=None, stream=None) -> None:
-    """Human-readable cost report: one block per entry point (totals plus
-    every collective launch site), then any baseline findings."""
+    """Human-readable cost report: one block per entry point (totals,
+    modeled step latency with the non-overlappable comm tail, plus every
+    collective launch site), then any baseline findings."""
     stream = stream or sys.stdout
     if mesh:
         print("modeled mesh: "
@@ -116,6 +125,14 @@ def render_cost_text(reports, findings: Iterable[Finding] = (), *,
               f"{r.peak_hbm_bytes} B ({_human_bytes(r.peak_hbm_bytes)}), "
               f"{len(r.collectives)} collective launch site(s)",
               file=stream)
+        lat = getattr(r, "latency", None)
+        if lat is not None:
+            print(f"  est step latency {_human_time(lat.step_latency_s)} "
+                  f"= compute {_human_time(lat.compute_s)} + comm tail "
+                  f"{_human_time(lat.comm_tail_s)} "
+                  f"(comm {_human_time(lat.comm_s)}, overlapped "
+                  f"{_human_time(lat.overlapped_s)}, {lat.launches} "
+                  f"launch(es))", file=stream)
         for c in r.collectives:
             axes = ",".join(c.axes) or "?"
             mult = f" x{c.multiplier}" if c.multiplier != 1 else ""

@@ -1,6 +1,7 @@
 """Input-pipeline layer: datasets, combinators, shard policies, distribution."""
 
-from tpu_dist.data.pipeline import AutoShardPolicy, Dataset, Options
+from tpu_dist.data.pipeline import (AutoShardPolicy, Dataset,
+                                    DevicePrefetcher, Options)
 from tpu_dist.data.sources import (
     DatasetInfo,
     SplitInfo,
@@ -21,6 +22,7 @@ __all__ = [
     "write_sharded",
     "AutoShardPolicy",
     "Dataset",
+    "DevicePrefetcher",
     "DatasetInfo",
     "Options",
     "SplitInfo",

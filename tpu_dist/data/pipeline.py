@@ -619,3 +619,108 @@ class Dataset:
         # auto_seeded flag the replicated-determinism guard reads).
         kw = {k: v for k, v in kw.items() if k != "auto_seeded"}
         return getattr(self, name)(**kw)
+
+
+class DevicePrefetcher:
+    """Double-buffered host→device input: a bounded background stage over an
+    iterator of ALREADY device-placing batches (``iter(DistributedDataset)``
+    runs ``strategy.distribute_batch`` — i.e. the ``device_put`` — inside
+    ``next()``, so moving the iteration onto this producer thread moves the
+    transfer off the training hot loop). While step k executes, up to
+    ``depth`` later batches are fetched and placed; the trainer's measured
+    ``data_wait_s`` collapses to a queue pop.
+
+    Same bounded-queue discipline as :meth:`Dataset.prefetch`: the producer
+    polls a stop event on every put so :meth:`close` (epoch-loop exit,
+    ``StopTraining``, preemption drain) never leaves a thread blocked on a
+    full queue. ``close()`` stops the producer, drains in-flight items, and
+    joins the thread — the no-leaked-threads teardown contract
+    (tests/test_step_perf.py).
+
+    Observability (host-side only): ``data.prefetch.hits`` / ``.misses``
+    counters (was the next batch already buffered when the trainer asked?)
+    and a ``data.prefetch.depth`` gauge of the buffered count — all through
+    :mod:`tpu_dist.observe.metrics`, so a disabled registry pays one flag
+    check.
+    """
+
+    def __init__(self, it: Iterator, *, depth: int = 2):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = int(depth)
+        self.hits = 0
+        self.misses = 0
+        self._q: queue_lib.Queue = queue_lib.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._exhausted = False
+        self._thread = threading.Thread(
+            target=self._produce, args=(it,), daemon=True,
+            name="tpu-dist-device-prefetch")
+        self._thread.start()
+
+    _SENTINEL = object()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue_lib.Full:
+                continue
+        return False
+
+    def _produce(self, it: Iterator) -> None:
+        try:
+            for batch in it:
+                if not self._put((batch, None)):
+                    return
+        except BaseException as e:  # propagate into the consumer
+            self._put((self._SENTINEL, e))
+            return
+        self._put((self._SENTINEL, None))
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self):
+        from tpu_dist.observe import metrics
+
+        if self._exhausted:
+            raise StopIteration
+        buffered = self._q.qsize()
+        metrics.set_gauge("data.prefetch.depth", buffered)
+        item, err = self._q.get()
+        if item is self._SENTINEL:
+            self._exhausted = True
+            if err is not None:
+                raise err
+            raise StopIteration
+        # Count hit/miss only for real batches — the terminal sentinel
+        # fetch is bookkeeping, so hits + misses == batches delivered.
+        if buffered > 0:
+            self.hits += 1
+            metrics.inc("data.prefetch.hits")
+        else:
+            self.misses += 1
+            metrics.inc("data.prefetch.misses")
+        return item
+
+    @property
+    def closed(self) -> bool:
+        """True once close() has fully torn down the producer thread."""
+        return self._stop.is_set() and not self._thread.is_alive()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the producer, drain in-flight batches, join the thread.
+        Idempotent; safe mid-stream (the batches dropped here were
+        speculative — exactly the teardown a preemption drain needs)."""
+        self._stop.set()
+        self._exhausted = True
+        # Drain so a producer blocked in put() observes the stop event and
+        # exits its poll loop promptly.
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue_lib.Empty:
+                break
+        self._thread.join(timeout=timeout)

@@ -49,6 +49,8 @@ class Model:
         self.loss = None
         self.metrics: list = []
         self.steps_per_execution = 1
+        self.gradient_bucket_bytes = 0
+        self.prefetch_to_device = 0
         self.strategy = None
         self._trainer = None
         self._carryover: Optional[dict] = None  # weights across recompiles
@@ -78,7 +80,9 @@ class Model:
     # -- Keras-style training surface (SURVEY.md D15/D16) ---------------------
 
     def compile(self, optimizer="sgd", loss=None, metrics=(),
-                steps_per_execution: int = 1) -> None:
+                steps_per_execution: int = 1,
+                gradient_bucket_bytes: int = 0,
+                prefetch_to_device: int = 0) -> None:
         """Record loss/optimizer/metrics and capture the scoped strategy
         (tf_dist_example.py:50-53 surface).
 
@@ -87,16 +91,39 @@ class Model:
         win when per-step device time is smaller than host dispatch overhead
         (tiny-model training; SURVEY.md hard-part #5). Batch-level callbacks
         and the progress bar then advance once per execution.
+
+        ``gradient_bucket_bytes``: 0 (default) keeps the fused schedule —
+        one implicit end-of-step gradient all-reduce, scheduled by the XLA
+        partitioner. > 0 switches the train step to the explicit bucketed
+        schedule: gradients are reduced in reverse-topological buckets of
+        roughly this many bytes so early buckets overlap the remaining
+        backward compute (README.md "Step-time performance"; the schedules
+        agree to float tolerance, not bitwise — the bucketed step averages
+        per-shard means).
+
+        ``prefetch_to_device``: 0 (default) fetches each batch on the hot
+        loop; > 0 double-buffers input — a background thread device_puts up
+        to this many batches ahead while the current step runs, driving the
+        trainer's measured ``data_wait_s`` toward zero.
         """
         from tpu_dist.parallel.strategy import get_strategy
 
         if steps_per_execution < 1:
             raise ValueError(
                 f"steps_per_execution must be >= 1, got {steps_per_execution}")
+        if gradient_bucket_bytes < 0:
+            raise ValueError(
+                f"gradient_bucket_bytes must be >= 0, got "
+                f"{gradient_bucket_bytes}")
+        if prefetch_to_device < 0:
+            raise ValueError(
+                f"prefetch_to_device must be >= 0, got {prefetch_to_device}")
         self.optimizer = optimizers_lib.get(optimizer)
         self.loss = losses_lib.get(loss) if loss is not None else None
         self.metrics = [metrics_lib.get(m) for m in metrics]
         self.steps_per_execution = int(steps_per_execution)
+        self.gradient_bucket_bytes = int(gradient_bucket_bytes)
+        self.prefetch_to_device = int(prefetch_to_device)
         self.strategy = get_strategy()
         # Invalidate the jitted step but carry trained weights forward —
         # recompiling must not reset a trained model (Keras fine-tuning

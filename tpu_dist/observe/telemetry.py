@@ -81,6 +81,14 @@ class StepTimer:
         self._data = r.distribution("step.data_wait_s")
         self._dispatch = r.distribution("step.dispatch_s")
         self._device = r.distribution("step.device_block_s")
+        # Overlap health of the step schedule: host-side collective wait
+        # (instrumented wrappers report it via comm_wait_s; in-program
+        # collectives are invisible to the host and land in device_block)
+        # and the fraction of execution wall time the device was actually
+        # busy — double-buffered input drives this toward 1.0 by taking
+        # data_wait out of the denominator's stall share.
+        self._comm = r.distribution("step.comm_wait_s")
+        self._overlap = r.distribution("step.overlap")
         self.reset_epoch()
 
     def reset_epoch(self) -> None:
@@ -89,9 +97,11 @@ class StepTimer:
         self.epoch_data_wait_s = 0.0
         self.epoch_dispatch_s = 0.0
         self.epoch_device_s = 0.0
+        self.epoch_comm_wait_s = 0.0
 
     def record_execution(self, *, steps: int, data_wait_s: float,
-                         dispatch_s: float, device_block_s: float) -> None:
+                         dispatch_s: float, device_block_s: float,
+                         comm_wait_s: float = 0.0) -> None:
         if steps <= 0:
             return
         total = data_wait_s + dispatch_s + device_block_s
@@ -101,11 +111,15 @@ class StepTimer:
         self._data.observe(data_wait_s * per)
         self._dispatch.observe(dispatch_s * per)
         self._device.observe(device_block_s * per)
+        self._comm.observe(comm_wait_s * per)
+        if total > 0:
+            self._overlap.observe(device_block_s / total)
         self.epoch_steps += steps
         self.epoch_total_s += total
         self.epoch_data_wait_s += data_wait_s
         self.epoch_dispatch_s += dispatch_s
         self.epoch_device_s += device_block_s
+        self.epoch_comm_wait_s += comm_wait_s
 
     def epoch_mean_step_s(self) -> float:
         if self.epoch_steps == 0:
@@ -132,6 +146,10 @@ def registry_collective_hook(
             r.counter(f"collective.{op}.bytes").inc(nbytes)
         if seconds is not None:
             r.distribution(f"collective.{op}.host_seconds").observe(seconds)
+            if phase != "trace":
+                # Host-visible collective wait, aggregated across ops —
+                # the measured sibling of the cost model's comm tail.
+                r.distribution("step.comm_wait_s").observe(seconds)
 
     return hook
 
@@ -237,7 +255,8 @@ class Telemetry(Callback):
             mean_step_s=round(mean_step, 6),
             data_wait_s=round(timer.epoch_data_wait_s, 6) if timer else 0.0,
             dispatch_s=round(timer.epoch_dispatch_s, 6) if timer else 0.0,
-            device_s=round(timer.epoch_device_s, 6) if timer else 0.0)
+            device_s=round(timer.epoch_device_s, 6) if timer else 0.0,
+            comm_wait_s=round(timer.epoch_comm_wait_s, 6) if timer else 0.0)
 
         from tpu_dist.cluster import bootstrap
 

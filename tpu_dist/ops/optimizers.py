@@ -98,12 +98,17 @@ class SGD(Optimizer):
 
     def __init__(self, learning_rate=0.01, momentum: float = 0.0,
                  nesterov: bool = False, clipnorm=None, clipvalue=None,
-                 global_clipnorm=None):
+                 global_clipnorm=None, fused: bool = False):
         from tpu_dist.ops import schedules
 
         self.learning_rate, self._scheduled = schedules.resolve(learning_rate)
         self.momentum = float(momentum)
         self.nesterov = bool(nesterov)
+        # Opt-in Pallas path (ops/pallas_kernels.fused_sgd_apply): the whole
+        # update as one kernel over the flattened parameter buffer instead
+        # of 2-3 HLO ops per leaf. Scheduled learning rates keep the jnp
+        # path — the fused kernel bakes lr in as a compile-time constant.
+        self.fused = bool(fused)
         self._set_clipping(clipnorm, clipvalue, global_clipnorm)
 
     def init(self, params):
@@ -121,6 +126,14 @@ class SGD(Optimizer):
         else:
             lr = self.learning_rate
             vel = state
+        if self.fused and not self._scheduled:
+            from tpu_dist.ops.pallas_kernels import fused_sgd_apply
+
+            new_params, new_vel = fused_sgd_apply(
+                params, grads, vel if self.momentum != 0.0 else None,
+                learning_rate=lr, momentum=self.momentum,
+                nesterov=self.nesterov)
+            return new_params, (new_vel if self.momentum != 0.0 else vel)
         if self.momentum == 0.0:
             new_params = jax.tree_util.tree_map(
                 lambda p, g: p - lr * g, params, grads)

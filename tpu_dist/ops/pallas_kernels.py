@@ -12,6 +12,13 @@ here:
   (backward), with a `jax.custom_vjp` tying them together. Replaces 4-5
   separate HLO reductions/gathers with one pass over the logits block.
 
+* :func:`fused_sgd_apply` — the whole SGD/momentum parameter update as ONE
+  kernel over the flattened parameter buffer: every leaf ravels into a
+  single padded fp32 vector, so N params x L leaves becomes one grid sweep
+  (p, g[, v] in; p'[, v'] out) instead of 2-3 elementwise HLO ops PER LEAF.
+  The win is launch/fusion overhead on many-leaf models, the same
+  launch-count economics the bucketed all-reduce targets on the comm side.
+
 Kernels run on TPU; every entry point takes ``interpret=`` (Pallas interpreter,
 used by the CPU test suite) and the public wrapper falls back to the plain
 jnp implementation on non-TPU backends, so the framework is correct
@@ -210,3 +217,130 @@ def fused_sparse_cross_entropy(logits, labels, *,
             return sparse_categorical_crossentropy(
                 logits, labels, from_logits=True).reshape(lead)
     return _fused_ce(logits, labels, interpret).reshape(lead)
+
+
+# -- fused SGD/momentum update ------------------------------------------------
+
+#: Lane width of the flattened update buffer; fp32 Mosaic tiles are (8, 128),
+#: so the padded vector reshapes to (rows, 128) with rows a multiple of 8.
+_SGD_LANES = 128
+_SGD_SUBLANES = 8
+
+
+def _sgd_kernel(lr, p_ref, g_ref, out_ref):
+    out_ref[:] = p_ref[:] - lr * g_ref[:]
+
+
+def _sgd_momentum_kernel(lr, m, nesterov, p_ref, g_ref, v_ref,
+                         newp_ref, newv_ref):
+    nv = m * v_ref[:] - lr * g_ref[:]
+    newv_ref[:] = nv
+    if nesterov:
+        newp_ref[:] = p_ref[:] + m * nv - lr * g_ref[:]
+    else:
+        newp_ref[:] = p_ref[:] + nv
+
+
+def _flatten_padded(leaves):
+    """Ravel + concat leaves into one fp32 (rows, 128) buffer, rows padded
+    to the sublane multiple. Returns (buffer, sizes, total)."""
+    sizes = [int(l.size) for l in leaves]
+    total = sum(sizes)
+    flat = jnp.concatenate(
+        [jnp.ravel(l).astype(jnp.float32) for l in leaves])
+    chunk = _SGD_LANES * _SGD_SUBLANES
+    padded = -(-max(total, 1) // chunk) * chunk
+    flat = jnp.pad(flat, (0, padded - total))
+    return flat.reshape(padded // _SGD_LANES, _SGD_LANES), sizes, total
+
+
+def _unflatten(buf, leaves, sizes, total, treedef):
+    flat = buf.reshape(-1)[:total]
+    out, offset = [], 0
+    for leaf, size in zip(leaves, sizes):
+        out.append(flat[offset:offset + size]
+                   .reshape(jnp.shape(leaf)).astype(leaf.dtype))
+        offset += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _sgd_pallas_call(kernel, n_in, n_out, buf_shape, *, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows = buf_shape[0]
+    tb = next(t for t in (128, 64, 32, 16, 8) if rows % t == 0)
+    space = pl.ANY if interpret else pltpu.VMEM
+    spec = pl.BlockSpec((tb, _SGD_LANES), lambda i: (i, 0),
+                        memory_space=space)
+    outs = [jax.ShapeDtypeStruct(buf_shape, jnp.float32)] * n_out
+    return pl.pallas_call(
+        kernel,
+        grid=(rows // tb,),
+        in_specs=[spec] * n_in,
+        out_specs=[spec] * n_out if n_out > 1 else spec,
+        out_shape=outs if n_out > 1 else outs[0],
+        interpret=interpret,
+    )
+
+
+def fused_sgd_apply(params, grads, velocity=None, *, learning_rate: float,
+                    momentum: float = 0.0, nesterov: bool = False,
+                    interpret: bool | None = None):
+    """One-kernel SGD/momentum update over a whole parameter pytree.
+
+    Returns ``(new_params, new_velocity)`` (``new_velocity is None`` when
+    ``momentum == 0``). Math matches :class:`tpu_dist.ops.optimizers.SGD`
+    leaf-for-leaf — the update runs in fp32 over the packed buffer and
+    casts back per leaf, so non-fp32 leaves agree to allclose rather than
+    bitwise. ``learning_rate``/``momentum`` must be Python floats (a
+    scheduled lr is a traced scalar; callers keep the jnp path for those).
+    Off-TPU the plain tree_map math runs unless ``interpret=True`` forces
+    the Pallas interpreter (the CPU-testable path).
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if interpret is None:
+        interpret = False
+        if not _on_tpu() or not leaves:
+            return _sgd_jnp(params, grads, velocity,
+                            lr=learning_rate, m=momentum, nesterov=nesterov)
+    if not leaves:
+        return _sgd_jnp(params, grads, velocity,
+                        lr=learning_rate, m=momentum, nesterov=nesterov)
+    lr = float(learning_rate)
+    m = float(momentum)
+    g_leaves = [jnp.asarray(g) for g in jax.tree_util.tree_leaves(grads)]
+    p_buf, sizes, total = _flatten_padded(
+        [jnp.asarray(l) for l in leaves])
+    g_buf, _, _ = _flatten_padded(g_leaves)
+    if m == 0.0:
+        call = _sgd_pallas_call(
+            functools.partial(_sgd_kernel, lr), 2, 1, p_buf.shape,
+            interpret=interpret)
+        new_p = call(p_buf, g_buf)
+        return _unflatten(new_p, leaves, sizes, total, treedef), None
+    v_leaves = [jnp.asarray(v)
+                for v in jax.tree_util.tree_leaves(velocity)]
+    v_buf, _, _ = _flatten_padded(v_leaves)
+    call = _sgd_pallas_call(
+        functools.partial(_sgd_momentum_kernel, lr, m, bool(nesterov)),
+        3, 2, p_buf.shape, interpret=interpret)
+    new_p, new_v = call(p_buf, g_buf, v_buf)
+    return (_unflatten(new_p, leaves, sizes, total, treedef),
+            _unflatten(new_v, v_leaves, sizes, total, treedef))
+
+
+def _sgd_jnp(params, grads, velocity, *, lr, m, nesterov):
+    """The reference tree_map math (optimizers.SGD), for off-TPU calls."""
+    if m == 0.0:
+        return (jax.tree_util.tree_map(lambda p, g: p - lr * g,
+                                       params, grads), None)
+    new_vel = jax.tree_util.tree_map(
+        lambda v, g: m * v - lr * g, velocity, grads)
+    if nesterov:
+        new_params = jax.tree_util.tree_map(
+            lambda p, v, g: p + m * v - lr * g, params, new_vel, grads)
+    else:
+        new_params = jax.tree_util.tree_map(
+            lambda p, v: p + v, params, new_vel)
+    return new_params, new_vel

@@ -21,7 +21,9 @@ from tpu_dist.parallel.collectives import (
     all_gather,
     all_reduce,
     broadcast_from_chief,
+    bucketed_all_reduce,
     host_all_reduce_sum,
+    partition_buckets,
     set_collective_logging,
 )
 from tpu_dist.parallel.sequence import (
@@ -72,7 +74,9 @@ __all__ = [
     "all_gather",
     "all_reduce",
     "broadcast_from_chief",
+    "bucketed_all_reduce",
     "host_all_reduce_sum",
+    "partition_buckets",
     "set_collective_logging",
     "SEQ_AXIS",
     "MODEL_AXIS",

@@ -113,6 +113,8 @@ class Trainer:
         self._iterator = None
         self._iterator_source = None
         self._iterator_kind = "device"
+        self._prefetcher = None
+        self._bucket_bytes = 0
         self._multi_step = None
         self._built_policy: Optional[str] = None
         self._metric_init_fn = None
@@ -327,6 +329,114 @@ class Trainer:
 
         return step
 
+    def _pure_step_bucketed(self, bucket_bytes: int):
+        """The explicit-schedule variant of :meth:`_pure_step`: forward/
+        backward runs per data shard under ``shard_map`` and the gradient
+        tree is reduced by :func:`~tpu_dist.parallel.collectives.
+        bucketed_all_reduce` in reverse-topological size buckets, instead
+        of leaving one fused end-of-step AllReduce to the XLA partitioner.
+        Each bucket is an independent psum launch the latency-hiding
+        scheduler can overlap with the remaining backward compute.
+
+        Parity contract: shards are equal-sized (iter_local validates
+        divisibility), so the mean-of-per-shard-means loss and the
+        bucket-packed gradient reduction match the fused schedule to float
+        tolerance — NOT bitwise; sums are reassociated (gated by allclose
+        in benchmarks/step_bench.py and tests/test_step_perf.py).
+        """
+        model, loss_obj, optimizer = (self.model, self.model.loss,
+                                      self.model.optimizer)
+        metrics = tuple(model.metrics)
+
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from tpu_dist.parallel import collectives
+        from tpu_dist.parallel.mesh import get_shard_map
+
+        class_weight = self._class_weight
+        device_transform = self._device_transform
+        mesh = self.strategy.mesh
+        axis = self.strategy.data_axis
+
+        def shard_body(params, state, x, y, rng):
+            def loss_fn(p):
+                logits, new_state = model.apply(p, state, x, training=True,
+                                                rng=rng)
+                aux = _aux_loss_total(new_state)
+                if class_weight is not None:
+                    if not jnp.issubdtype(y.dtype, jnp.integer):
+                        raise ValueError(
+                            "class_weight requires sparse integer labels; "
+                            f"got labels of dtype {y.dtype}")
+                    per = loss_obj.per_example(logits, y)
+                    if per.shape != y.shape:
+                        raise ValueError(
+                            "class_weight requires per-example labels "
+                            f"matching the loss (labels {y.shape} vs "
+                            f"per-example loss {per.shape})")
+                    w = jnp.ones_like(per)
+                    for c, wt in class_weight.items():
+                        w = jnp.where(y == c, jnp.float32(wt), w)
+                    return (per * w).mean() + aux, (logits, new_state)
+                return loss_obj(logits, y) + aux, (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            loss = jax.lax.pmean(loss, axis)
+            grads = collectives.bucketed_all_reduce(
+                grads, axis, collectives.ReduceOp.MEAN,
+                bucket_bytes=bucket_bytes)
+            # Cross-replica state mean (sync-BatchNorm-like semantics for
+            # stateful layers); a pure model's empty state tree is free.
+            new_state = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, axis), new_state)
+            return loss, grads, logits, new_state
+
+        sm = get_shard_map()
+        in_specs = (P(), P(), P(axis), P(axis), P())
+        out_specs = (P(), P(), P(axis), P())
+        try:
+            sharded = sm(shard_body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+        except TypeError:  # pre-0.8 jax spells it check_rep
+            sharded = sm(shard_body, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+        def step(params, state, opt_state, metric_states, loss_acc, x, y,
+                 rng):
+            if device_transform is not None:
+                x = device_transform(x)
+            loss, grads, logits, new_state = sharded(params, state, x, y, rng)
+            new_params, new_opt = optimizer.update(grads, opt_state, params)
+            new_metrics = tuple(
+                m.update(ms, logits, y)
+                for m, ms in zip(metrics, metric_states))
+            new_acc = (loss_acc[0] + loss, loss_acc[1] + 1.0)
+            from tpu_dist.training.integrity import health_summary
+
+            health = health_summary(loss, grads, params, new_params)
+            return (loss, new_params, new_state, new_opt, new_metrics,
+                    new_acc, health)
+
+        return step
+
+    def _pure_train_step(self):
+        """The schedule the compiled steps build from: bucketed when the
+        model compiled with ``gradient_bucket_bytes > 0``, else fused."""
+        if self._bucket_bytes > 0:
+            return self._pure_step_bucketed(self._bucket_bytes)
+        return self._pure_step()
+
+    def _sync_step_knobs(self) -> None:
+        """Adopt the model's gradient-schedule knob; a changed bucket size
+        is a trace-time property, so the compiled steps rebuild."""
+        bb = int(getattr(self.model, "gradient_bucket_bytes", 0) or 0)
+        if bb != self._bucket_bytes:
+            self._bucket_bytes = bb
+            self._train_step = None
+            self._multi_step = None
+
     def _out_shardings(self):
         rep = self.strategy.param_sharding()
 
@@ -364,7 +474,8 @@ class Trainer:
         cw = self._class_weight
         return (self._built_policy,
                 self._transform_key(self._device_transform),
-                None if cw is None else tuple(sorted(cw.items())))
+                None if cw is None else tuple(sorted(cw.items())),
+                self._bucket_bytes)
 
     def _eval_variant(self) -> tuple:
         return (self._built_policy,
@@ -372,7 +483,7 @@ class Trainer:
 
     def _build_train_step(self):
         return jax.jit(
-            self._pure_step(),
+            self._pure_train_step(),
             out_shardings=self._out_shardings(),
             donate_argnums=(0, 1, 2, 3, 4),
         )
@@ -388,7 +499,7 @@ class Trainer:
         carries (params, state, opt, metrics, loss_acc) and the mean of the
         K losses is returned as the execution's loss.
         """
-        step = self._pure_step()
+        step = self._pure_train_step()
 
         def one(carry, xs):
             x, y, rng = xs
@@ -438,6 +549,7 @@ class Trainer:
         """
         self.ensure_variables()
         self._maybe_invalidate_for_policy()
+        self._sync_step_knobs()
         if self._class_weight is not None:
             self._class_weight = None
             self._train_step = None
@@ -532,10 +644,19 @@ class Trainer:
     def _next_batch(self, dist: DistributedDataset, *, host: bool = False):
         """Persistent-iterator semantics across epochs (Keras 2): re-create on
         exhaustion — a fresh pass implies a fresh (re)shuffle. ``host=True``
-        yields the pre-placement numpy batch (multi-step stacking path)."""
+        yields the pre-placement numpy batch (multi-step stacking path).
+        With ``prefetch_to_device > 0`` compiled on the model, the device
+        path routes through a :class:`~tpu_dist.data.pipeline.
+        DevicePrefetcher` — batch k+1's device placement runs on a
+        background thread while step k executes."""
+        if not host and int(getattr(self.model, "prefetch_to_device", 0)
+                            or 0) > 0:
+            return self._next_prefetched(
+                dist, int(self.model.prefetch_to_device))
         kind = "host" if host else "device"
         if (self._iterator is None or self._iterator_source is not dist
                 or self._iterator_kind != kind):
+            self._close_prefetcher()
             self._iterator = dist.iter_local() if host else iter(dist)
             self._iterator_source = dist
             self._iterator_kind = kind
@@ -548,6 +669,42 @@ class Trainer:
                 raise RuntimeError("dataset yielded no batches")
             return batch
 
+    def _next_prefetched(self, dist: DistributedDataset, depth: int):
+        """Double-buffered device fetch: same persistent-iterator semantics
+        as :meth:`_next_batch`'s device path, with the iteration (and its
+        ``device_put``) pushed onto the prefetcher's producer thread."""
+        from tpu_dist.data.pipeline import DevicePrefetcher
+
+        if (self._prefetcher is None or self._iterator_source is not dist
+                or self._iterator_kind != "prefetch"):
+            self._close_prefetcher()
+            self._iterator = None
+            self._prefetcher = DevicePrefetcher(iter(dist), depth=depth)
+            self._iterator_source = dist
+            self._iterator_kind = "prefetch"
+        try:
+            return next(self._prefetcher)
+        except StopIteration:
+            self._close_prefetcher()
+            self._prefetcher = DevicePrefetcher(iter(dist), depth=depth)
+            self._iterator_source = dist
+            self._iterator_kind = "prefetch"
+            try:
+                return next(self._prefetcher)
+            except StopIteration:
+                self._close_prefetcher()
+                raise RuntimeError("dataset yielded no batches") from None
+
+    def _close_prefetcher(self) -> None:
+        """Tear down the device prefetcher (epoch-loop exit, StopTraining,
+        preemption drain, rollback): stops the producer, drains in-flight
+        batches, joins the thread."""
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+            if self._iterator_kind == "prefetch":
+                self._iterator_source = None
+
     # -- fit / evaluate / predict ---------------------------------------------
 
     def fit(self, x, *, epochs: int, steps_per_epoch: Optional[int],
@@ -558,6 +715,7 @@ class Trainer:
             class_weight: Optional[dict] = None) -> History:
         self.ensure_variables(seed)
         self._maybe_invalidate_for_policy()
+        self._sync_step_knobs()
         if class_weight is not None:
             class_weight = {int(c): float(w) for c, w in class_weight.items()}
             if any(c < 0 for c in class_weight):
@@ -710,6 +868,11 @@ class Trainer:
         except StopTraining as e:
             logger.info("training stopped early: %s", e)
         finally:
+            # Tear down the device prefetcher FIRST — StopTraining and a
+            # preemption drain land here with a producer thread possibly
+            # mid-device_put, and callbacks (checkpoint publish) must see a
+            # quiesced pipeline.
+            self._close_prefetcher()
             # Runs even on the failure path (e.g. PeerUnavailableError) so
             # callbacks finalize — a JSONLogger's file matters most there.
             cbs.on_train_end()
@@ -744,6 +907,7 @@ class Trainer:
         # identical to what a gang-restarted attempt would see (persistent
         # iterators are recreated per pass when cardinality matches).
         self._iterator = None
+        self._close_prefetcher()
         guard.note_rollback(rb, restored)
         metrics_lib.inc("integrity.rollbacks")
         events.maybe_log("integrity_rollback", kind=rb.kind, step=rb.gstep,
