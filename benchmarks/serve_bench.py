@@ -30,6 +30,20 @@ Gates (exit 1 on failure)
 * continuous p99 request latency <= ``--p99-target`` seconds — the
   "throughput at a fixed p99 target" number the report leads with.
 
+Paged dimension (two more phases, same exit-1 gates)
+----------------------------------------------------
+* **capacity**: a fixed HBM budget sized for ``--max-batch`` contiguous
+  slots is handed to a paged engine instead. Contiguous must reserve
+  ``max_len`` tokens per slot; pages are granted on demand, so the same
+  bytes admit every request whose *actual* length fits — the bench
+  pins that the paged engine (a) streams token-identically to the
+  contiguous engine on the same backlog and (b) holds >= 2x the
+  concurrent requests at that budget, both statically (pages / pages-
+  per-request) and as measured peak concurrency;
+* **prefix**: requests sharing a long prompt prefix served one at a
+  time; prefix-cache hits skip the shared pages at prefill, so warm
+  TTFT p50 must be <= ``--prefix-ttft-frac`` (default 0.5) of cold.
+
 Writes ``BENCH_SERVE.json`` (see ``--out``).
 """
 
@@ -123,6 +137,135 @@ def _measure(args, policy: str) -> dict:
     }
 
 
+def _paged_workload(args, n) -> list[dict]:
+    """Ragged backlog where every request fits in <= 3 pages of 8 —
+    prompt 2..13 plus 3..10 new tokens caps total length at 23."""
+    rng = np.random.default_rng(args.seed + 1)
+    return [{"prompt": rng.integers(0, VOCAB,
+                                    size=int(rng.integers(2, 14))).tolist(),
+             "max_new_tokens": int(rng.integers(3, 11))}
+            for _ in range(n)]
+
+
+def _measure_paged_capacity(args) -> dict:
+    """Same HBM budget, contiguous vs paged: stream parity + >= 2x the
+    concurrent requests."""
+    from tpu_dist.models.transformer import build_transformer_lm
+    from tpu_dist.serve import kv_cache
+    from tpu_dist.serve.engine import ServeEngine
+
+    def lm():
+        return build_transformer_lm(VOCAB, MAX_LEN, d_model=args.d_model,
+                                    depth=args.depth, num_heads=4)
+
+    page_size = 8
+    model = lm()
+    plan = kv_cache.build_plan(model)
+    budget = kv_cache.cache_nbytes(plan, max_batch=args.max_batch,
+                                   max_len=MAX_LEN)
+    work = _paged_workload(args, n=24)
+    pages_per_req = max(
+        -(-min(len(w["prompt"]) + w["max_new_tokens"], MAX_LEN) // page_size)
+        for w in work)
+
+    def drive(engine):
+        reqs = [engine.submit(w["prompt"],
+                              max_new_tokens=w["max_new_tokens"])
+                for w in work]
+        peak = 0
+        steps = 0
+        while not engine.scheduler.idle():
+            engine.step()
+            peak = max(peak, engine.scheduler.num_active)
+            steps += 1
+        done = sum(1 for r in reqs if r.status == "done"
+                   and len(r.generated) == r.max_new_tokens)
+        return {r.rid: list(r.generated) for r in reqs}, peak, done
+
+    contiguous = ServeEngine(lm(), max_batch=args.max_batch,
+                             max_len=MAX_LEN, seed=args.seed,
+                             budget_bytes=budget)
+    want, _, cont_done = drive(contiguous)
+
+    # Slot count out of the way (2x max_batch): concurrency is bounded by
+    # free-page headroom alone, i.e. by the byte budget.
+    paged = ServeEngine(lm(), max_batch=2 * args.max_batch,
+                        max_len=MAX_LEN, seed=args.seed, paged=True,
+                        page_size=page_size, budget_bytes=budget,
+                        prefix_caching=False)
+    got, peak, paged_done = drive(paged)
+    static_capacity = paged.num_pages // pages_per_req
+    return {
+        "budget_bytes": int(budget),
+        "page_size": page_size,
+        "num_pages": paged.num_pages,
+        "pages_per_request": pages_per_req,
+        "requests": len(work),
+        "contiguous_slots": args.max_batch,
+        "completed": {"contiguous": cont_done, "paged": paged_done},
+        "streams_match": got == want,
+        "static_capacity": static_capacity,
+        "peak_concurrency": peak,
+    }
+
+
+def _measure_prefix(args) -> dict:
+    """Sequential TTFT, cold misses vs warm prefix-cache hits. A beefier
+    model than the batching phases so prefill compute (what the hit
+    skips) dominates per-call dispatch overhead."""
+    from tpu_dist.models.transformer import build_transformer_lm
+    from tpu_dist.serve.engine import ServeEngine
+
+    seq_len, pre_tokens = 256, 192  # 24 full pages of shared prefix
+    model = build_transformer_lm(VOCAB, seq_len, d_model=256, depth=4,
+                                 num_heads=4)
+    engine = ServeEngine(model, max_batch=1, max_len=seq_len,
+                         seed=args.seed, paged=True, page_size=8,
+                         num_pages=128)
+    rng = np.random.default_rng(args.seed + 2)
+
+    def prefix():
+        return rng.integers(0, VOCAB, size=pre_tokens).tolist()
+
+    def ttft(prompt):
+        # Client-observed time to the first (and only) token. The
+        # engine's internal ttft_s stamps before the async dispatch
+        # resolves, so wall-clock around the request is the honest
+        # number — run_until_idle returns only after the token is host-
+        # side, and with max_new_tokens=1 that IS first-token latency.
+        t0 = time.monotonic()
+        engine.submit(prompt, max_new_tokens=1)
+        engine.run_until_idle()
+        return time.monotonic() - t0
+
+    # Warmup on a throwaway prefix: compiles the cold (pad-256) and warm
+    # (pad-2) prefill programs so no measured request pays a trace.
+    w = prefix()
+    ttft(w + [1, 2])
+    ttft(w + [3, 4])
+
+    cold, warm = [], []
+    for _ in range(5):
+        cold.append(ttft(prefix() + [5, 6]))  # fresh prefix: all-miss
+    shared = prefix()
+    ttft(shared + [7, 8])  # seeds the cache; a miss, not measured
+    for i in range(5):
+        warm.append(ttft(shared + [9 + i, 10 + i]))
+    hits = engine._paging.prefix.hits
+    cold_p50 = float(np.median(cold))
+    warm_p50 = float(np.median(warm))
+    return {
+        "prefix_tokens": pre_tokens,
+        "cold_requests": len(cold),
+        "warm_requests": len(warm),
+        "prefix_hits": hits,
+        "cold_ttft_p50_s": round(cold_p50, 6),
+        "warm_ttft_p50_s": round(warm_p50, 6),
+        "warm_over_cold": (round(warm_p50 / cold_p50, 4)
+                           if cold_p50 > 0 else None),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=32)
@@ -139,6 +282,9 @@ def main(argv=None) -> int:
                    help="gate: continuous/static throughput ratio floor — "
                         "'measurably outperforms', not 'ties within noise' "
                         "(measured 1.2-1.4x at the defaults)")
+    p.add_argument("--prefix-ttft-frac", type=float, default=0.5,
+                   help="gate: warm (prefix-hit) TTFT p50 must be <= "
+                        "this fraction of cold TTFT p50")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=str(pathlib.Path(__file__).parent.parent
                                         / "BENCH_SERVE.json"))
@@ -148,6 +294,10 @@ def main(argv=None) -> int:
     static = _measure(args, "static")
     print("measuring continuous batching...", file=sys.stderr)
     continuous = _measure(args, "continuous")
+    print("measuring paged capacity at fixed budget...", file=sys.stderr)
+    capacity = _measure_paged_capacity(args)
+    print("measuring prefix-cache TTFT...", file=sys.stderr)
+    prefix = _measure_prefix(args)
 
     speedup = (continuous["throughput_tok_s"] / static["throughput_tok_s"]
                if static["throughput_tok_s"] else None)
@@ -161,6 +311,17 @@ def main(argv=None) -> int:
         "continuous_beats_static": (
             speedup is not None and speedup >= args.min_speedup),
         "p99_within_target": p99 is not None and p99 <= args.p99_target,
+        "paged_all_completed": (
+            capacity["completed"]["contiguous"] == capacity["requests"]
+            and capacity["completed"]["paged"] == capacity["requests"]),
+        "paged_streams_match_contiguous": capacity["streams_match"],
+        "paged_capacity_2x": (
+            capacity["static_capacity"] >= 2 * capacity["contiguous_slots"]
+            and capacity["peak_concurrency"]
+            >= 2 * capacity["contiguous_slots"]),
+        "prefix_hit_ttft": (
+            prefix["warm_over_cold"] is not None
+            and prefix["warm_over_cold"] <= args.prefix_ttft_frac),
     }
     report = {
         "bench": "serve",
@@ -173,6 +334,8 @@ def main(argv=None) -> int:
             else None),
         "static": static,
         "continuous": continuous,
+        "paged_capacity": capacity,
+        "prefix_cache": prefix,
         "continuous_over_static": (round(speedup, 4)
                                    if speedup is not None else None),
         "gates": gates,

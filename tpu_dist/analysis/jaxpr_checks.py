@@ -637,6 +637,53 @@ def _trace_serve_decode():
         params, cache, tokens, lengths)
 
 
+def _trace_serve_paged_prefill():
+    """``serve.kv_cache.paged_prefill`` — the suffix prefill that writes
+    K/V through a page table onto the paged pool (serve/paging.py). One
+    program serves cold prompts (start=0) and prefix-cache hits alike.
+    Pins it collective-free like the contiguous prefill, and baselines
+    the page-gather HBM cost so an accidental pool-sized temporary (e.g.
+    gathering every pool page instead of the slot's table row) gates
+    CI."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, _ = _serve_probe()
+    pool = kv_cache.init_page_pool(plan, num_pages=8, page_size=4)
+    page_row = jnp.zeros((4,), jnp.int32)
+    tokens = jnp.zeros((8,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, r, t: kv_cache.paged_prefill(
+            plan, p, c, r, t, jnp.int32(5), jnp.int32(0)))(
+        params, pool, page_row, tokens)
+
+
+def _trace_serve_paged_decode():
+    """``serve.kv_cache.paged_decode_step`` — the paged serving hot loop:
+    tail-page scatter append + attention over gathered pages. Pins it
+    collective-free and baselines comm/HBM alongside the contiguous
+    ``serve.decode_step``, so the paged subsystem's device cost is
+    budgeted exactly like the path it replaces (the host-side allocator,
+    prefix cache, and copy-on-write bookkeeping must add nothing
+    here)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, _ = _serve_probe()
+    pool = kv_cache.init_page_pool(plan, num_pages=8, page_size=4)
+    tables = jnp.zeros((4, 4), jnp.int32)
+    tokens = jnp.zeros((4,), jnp.int32)
+    lengths = jnp.ones((4,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, tb, t, ln: kv_cache.paged_decode_step(
+            plan, p, c, tb, t, ln, bucket=4))(
+        params, pool, tables, tokens, lengths)
+
+
 def _trace_integrity_health_step():
     """The trainer step WITH the in-step health vector — same program the
     plain train_step entry traces (health_summary is always folded in), but
@@ -763,6 +810,8 @@ ENTRY_POINTS = {
     "training.checkpoint.snapshot_copy": _trace_checkpoint_snapshot,
     "serve.prefill_step": _trace_serve_prefill,
     "serve.decode_step": _trace_serve_decode,
+    "serve.paged_prefill": _trace_serve_paged_prefill,
+    "serve.paged_decode_step": _trace_serve_paged_decode,
     "training.integrity.health_step": _trace_integrity_health_step,
     "training.integrity.audit_checksum": _trace_integrity_audit_checksum,
     "jobs.runtime.train_step": _trace_jobs_runtime_train_step,

@@ -44,11 +44,17 @@ def _build_engine(args, *, policy: Optional[str] = None, **engine_kwargs):
     from tpu_dist.models.transformer import build_transformer_lm
     from tpu_dist.serve.engine import ServeEngine
 
+    paged_kwargs = {}
+    if getattr(args, "paged", False):
+        paged_kwargs = {"paged": True, "page_size": args.page_size,
+                        "num_pages": args.num_pages}
+    if getattr(args, "budget_mb", None) is not None:
+        paged_kwargs["budget_bytes"] = int(args.budget_mb * 2**20)
     if args.model_dir:
         return ServeEngine.from_saved(
             args.model_dir, max_batch=args.max_batch,
             policy=policy or args.policy, temperature=args.temperature,
-            seed=args.seed, **engine_kwargs)
+            seed=args.seed, **paged_kwargs, **engine_kwargs)
     model = build_transformer_lm(args.vocab, args.max_len,
                                  d_model=args.d_model, depth=args.depth,
                                  num_heads=args.num_heads)
@@ -56,7 +62,7 @@ def _build_engine(args, *, policy: Optional[str] = None, **engine_kwargs):
                        max_len=args.max_len,
                        policy=policy or args.policy,
                        temperature=args.temperature, seed=args.seed,
-                       **engine_kwargs)
+                       **paged_kwargs, **engine_kwargs)
 
 
 def _workload(args) -> list[dict]:
@@ -208,6 +214,18 @@ def main(argv=None) -> int:
     p.add_argument("--num-heads", type=int, default=4)
     p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
+    # -- paged KV cache (README "Paged KV & prefix caching") ---------------
+    p.add_argument("--paged", action="store_true",
+                   help="paged KV cache + prefix reuse instead of the "
+                        "contiguous per-slot preallocation")
+    p.add_argument("--page-size", type=int, default=16,
+                   help="positions per KV page (with --paged)")
+    p.add_argument("--num-pages", type=int, default=None,
+                   help="page-pool size (default: contiguous-capacity "
+                        "parity, max_batch * ceil(max_len/page_size))")
+    p.add_argument("--budget-mb", type=float, default=None,
+                   help="KV memory budget in MiB — loud sizing error "
+                        "(contiguous) or pool auto-sizing (--paged)")
     # -- resilience / chaos (README "Serving resilience") -----------------
     p.add_argument("--worker", action="store_true",
                    help="supervised serve worker: journal + fault plan "
@@ -278,6 +296,8 @@ def main(argv=None) -> int:
                            "max_len": args.max_len,
                            "clients": args.clients,
                            "arrival_rate": args.arrival_rate,
+                           "paged": bool(args.paged),
+                           "page_size": args.page_size,
                            "seed": args.seed},
                 **summary,
             }

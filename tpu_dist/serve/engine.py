@@ -57,7 +57,7 @@ import numpy as np
 from tpu_dist.models.model import Sequential
 from tpu_dist.observe import metrics
 from tpu_dist.parallel.strategy import get_strategy
-from tpu_dist.serve import kv_cache
+from tpu_dist.serve import kv_cache, paging
 from tpu_dist.serve import journal as journal_lib
 from tpu_dist.serve.scheduler import DONE, SHED, Request, Scheduler
 
@@ -153,6 +153,28 @@ class ServeEngine:
         step — a deterministic stand-in for a production-sized model's
         step time, used by the request-storm chaos gate so queueing-delay
         measurements don't depend on host speed.
+      paged: select the paged KV-cache subsystem (``serve/paging.py``):
+        HBM is carved into fixed-size pages addressed through per-slot
+        page tables, admission consults free-page headroom instead of
+        slot count alone, repeated prompt prefixes resolve to shared
+        read-only pages (prefill runs only over the suffix), and slot
+        compaction becomes a host pointer swap. Greedy token streams are
+        bit-identical to the contiguous default (tests + serve-bench pin
+        it). Default False: the contiguous path and its compiled
+        programs are untouched.
+      page_size: positions per page (paged mode). Small pages waste less
+        HBM on short requests and share prefixes at finer grain; large
+        pages mean fewer gather indices per attention step.
+      num_pages: pool size (paged mode). Defaults to
+        ``max_batch * ceil(max_len / page_size)`` — contiguous-capacity
+        parity; pass fewer (or a ``budget_bytes``) to overcommit slots
+        against actual request lengths.
+      budget_bytes: hard KV-memory bound. Contiguous mode: raise a loud
+        sizing error (how many slots fit) instead of an XLA OOM. Paged
+        mode: sizes ``num_pages`` to the budget when ``num_pages`` is
+        not given, else guards the explicit pool the same way.
+      prefix_caching: paged mode only — disable to keep paging without
+        cross-request prefix sharing (parity baselines use this).
     """
 
     def __init__(self, model: Sequential, *, max_batch: int = 8,
@@ -164,7 +186,10 @@ class ServeEngine:
                  max_ttft_s: Optional[float] = None, retry_budget: int = 3,
                  stall_timeout_s: Optional[float] = None,
                  stall_action=None, fault_injector=None,
-                 virtual_step_s: float = 0.0):
+                 virtual_step_s: float = 0.0, paged: bool = False,
+                 page_size: int = 16, num_pages: Optional[int] = None,
+                 budget_bytes: Optional[int] = None,
+                 prefix_caching: bool = True):
         self.model = model
         self.plan = kv_cache.build_plan(model)
         self.max_len = int(max_len or self.plan.max_position)
@@ -192,16 +217,53 @@ class ServeEngine:
         # Same mesh placement training uses; on the default single-device
         # strategy this is a no-op device_put.
         self.params = self.strategy.replicate(params)
-        self.cache = self.strategy.replicate(kv_cache.init_cache(
-            self.plan, max_batch=self.max_batch, max_len=self.max_len,
-            dtype=cache_dtype))
-        logger.info(
-            "serve: %d slots x %d positions, KV cache %.1f MiB, "
-            "buckets %s", self.max_batch, self.max_len,
-            kv_cache.cache_nbytes(self.plan, max_batch=self.max_batch,
-                                  max_len=self.max_len,
-                                  dtype=cache_dtype) / 2**20,
-            buckets or "pow2")
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
+        if self.paged:
+            max_pages = -(-self.max_len // self.page_size)
+            if num_pages is None and budget_bytes is not None:
+                num_pages = kv_cache.pages_for_budget(
+                    self.plan, page_size=self.page_size,
+                    budget_bytes=budget_bytes, dtype=cache_dtype)
+                if num_pages < 1:
+                    raise ValueError(
+                        f"serve: budget_bytes={budget_bytes} does not fit "
+                        "even one page (plus scratch) at page_size="
+                        f"{self.page_size}")
+            if num_pages is None:
+                num_pages = self.max_batch * max_pages
+            self.num_pages = int(num_pages)
+            self.cache = self.strategy.replicate(kv_cache.init_page_pool(
+                self.plan, num_pages=self.num_pages,
+                page_size=self.page_size, dtype=cache_dtype,
+                budget_bytes=budget_bytes))
+            per_token = (2 * self.plan.num_layers * self.plan.num_heads
+                         * self.plan.key_dim
+                         * jnp.dtype(cache_dtype).itemsize)
+            self._paging = paging.PagedKVState(
+                num_pages=self.num_pages, page_size=self.page_size,
+                slots=self.max_batch, max_pages=max_pages,
+                bytes_per_token=per_token, prefix_caching=prefix_caching)
+            logger.info(
+                "serve: paged — %d slots, %d pages x %d positions "
+                "(+scratch), pool %.1f MiB, prefix caching %s, buckets %s",
+                self.max_batch, self.num_pages, self.page_size,
+                kv_cache.page_pool_nbytes(
+                    self.plan, num_pages=self.num_pages,
+                    page_size=self.page_size, dtype=cache_dtype) / 2**20,
+                "on" if prefix_caching else "off", buckets or "pow2")
+        else:
+            self._paging = None
+            self.cache = self.strategy.replicate(kv_cache.init_cache(
+                self.plan, max_batch=self.max_batch, max_len=self.max_len,
+                dtype=cache_dtype, budget_bytes=budget_bytes))
+            logger.info(
+                "serve: %d slots x %d positions, KV cache %.1f MiB, "
+                "buckets %s", self.max_batch, self.max_len,
+                kv_cache.cache_nbytes(self.plan, max_batch=self.max_batch,
+                                      max_len=self.max_len,
+                                      dtype=cache_dtype) / 2**20,
+                buckets or "pow2")
 
         self.scheduler = Scheduler(self.max_batch, buckets=buckets,
                                    policy=policy, max_queue=max_queue)
@@ -218,6 +280,10 @@ class ServeEngine:
         self._prefill_fns: dict[int, callable] = {}
         self._donate = donate
         self._swap_fn = jax.jit(kv_cache.swap_slots,
+                                donate_argnums=(0,) if donate else ())
+        self._paged_decode_fns: dict[int, callable] = {}
+        self._paged_prefill_fns: dict[int, callable] = {}
+        self._copy_fn = jax.jit(kv_cache.copy_page,
                                 donate_argnums=(0,) if donate else ())
 
         # -- resilience state --------------------------------------------
@@ -365,11 +431,41 @@ class ServeEngine:
             self._prefill_fns[pad_len] = fn
         return fn
 
+    def _paged_decode_fn(self, bucket: int):
+        fn = self._paged_decode_fns.get(bucket)
+        if fn is None:
+            fn = self._acquire_program(
+                "paged_decode", bucket,
+                lambda: jax.jit(
+                    functools.partial(kv_cache.paged_decode_step, self.plan,
+                                      bucket=bucket),
+                    donate_argnums=self._donate))
+            self._paged_decode_fns[bucket] = fn
+        return fn
+
+    def _paged_prefill_fn(self, pad_len: int):
+        fn = self._paged_prefill_fns.get(pad_len)
+        if fn is None:
+            fn = self._acquire_program(
+                "paged_prefill", pad_len,
+                lambda: jax.jit(
+                    functools.partial(kv_cache.paged_prefill, self.plan),
+                    donate_argnums=self._donate))
+            self._paged_prefill_fns[pad_len] = fn
+        return fn
+
     def compiled_programs(self) -> dict:
         """{'decode': [buckets...], 'prefill': [pad_lens...]} — tests pin
-        the no-retrace property on this."""
-        return {"decode": sorted(self._decode_fns),
-                "prefill": sorted(self._prefill_fns)}
+        the no-retrace property on this. Paged engines report their
+        ``paged_decode``/``paged_prefill`` surfaces too (a suffix prefill
+        after a prefix hit pads to a smaller power of two, so warm and
+        cold prefills land in different — but both steady — programs)."""
+        out = {"decode": sorted(self._decode_fns),
+               "prefill": sorted(self._prefill_fns)}
+        if self.paged:
+            out["paged_decode"] = sorted(self._paged_decode_fns)
+            out["paged_prefill"] = sorted(self._paged_prefill_fns)
+        return out
 
     # -- request intake -------------------------------------------------------
 
@@ -381,6 +477,11 @@ class ServeEngine:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens does not fit a "
                 f"{self.max_len}-position cache slot (need >= 1 free)")
+        if self.paged:
+            # Reject a request that could never fit even an empty pool
+            # now, loudly, instead of deadlocking admission later.
+            self._paging.check_fits(
+                min(len(prompt) + int(max_new_tokens), self.max_len))
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       eos_id=eos_id, deadline_s=deadline_s)
         cause = self._shed_cause(req)
@@ -460,12 +561,29 @@ class ServeEngine:
         if swap is None:
             return
         i, j = swap
-        self.cache = self._swap_fn(self.cache, jnp.int32(i), jnp.int32(j))
+        if self.paged:
+            # Compaction under paging is a host page-table pointer swap —
+            # no device program runs.
+            self._paging.swap_slots(i, j)
+        else:
+            self.cache = self._swap_fn(self.cache, jnp.int32(i),
+                                       jnp.int32(j))
         self._tokens[[i, j]] = self._tokens[[j, i]]
         self._lengths[[i, j]] = self._lengths[[j, i]]
 
+    def _release_pages(self, req: Request) -> None:
+        """Paged reclaim for a request that just left its slot: index its
+        prompt's tail chunk for future prefix hits, then drop the slot's
+        page references (compaction-free — freed pages go straight back
+        on the free list). Must run BEFORE the mirrored slot swap, while
+        the allocator row still belongs to this request."""
+        if self.paged and req.released_slot is not None:
+            self._paging.finish(req.released_slot, req.prompt)
+            req.released_slot = None
+
     def _retire(self, req: Request, *, now: float, status: str) -> None:
         swap = self.scheduler.finish(req, now=now, status=status)
+        self._release_pages(req)
         self._apply_swap(swap)
         self.finished.append(req)
         if self.journal is not None:
@@ -481,6 +599,20 @@ class ServeEngine:
         else:
             metrics.inc("serve.requests.evicted")
 
+    def _total_tokens(self, req: Request) -> int:
+        """Worst-case positions this request can occupy — the paged
+        admission/reservation unit."""
+        return min(len(req.prompt) + len(req.generated)
+                   + max(req.max_new_tokens - len(req.generated), 0),
+                   self.max_len)
+
+    def _admission_gate(self, req: Request) -> bool:
+        """Paged admission: a slot is only half the question — the pool
+        must also hold this request's worst case. Reserving up front
+        keeps every later incremental allocation (decode appends, COW
+        clones) deadlock-free."""
+        return self._paging.try_admit(self._total_tokens(req))
+
     def _prefill(self, req: Request) -> None:
         # A journal-recovered request re-prefills with prompt + everything
         # it had already generated: the incremental-decode ≡ full-forward
@@ -488,13 +620,31 @@ class ServeEngine:
         # uninterrupted run (req.generated is empty on the normal path).
         seq = list(req.prompt) + list(req.generated)
         plen = len(seq)
-        pad = _pad_to_pow2(plen, hi=self.max_len)
-        tokens = np.zeros(pad, np.int32)
-        tokens[:plen] = seq
-        fn = self._prefill_fn(pad)
-        self.cache, logits = fn(self.params, self.cache,
-                                jnp.asarray(tokens), jnp.int32(plen),
-                                jnp.int32(req.slot))
+        if self.paged:
+            setup = self._paging.begin(req.slot, seq,
+                                       self._total_tokens(req))
+            for src, dst in setup.copies:
+                self.cache = self._copy_fn(self.cache, jnp.int32(src),
+                                           jnp.int32(dst))
+            suffix = plen - setup.start
+            pad = _pad_to_pow2(suffix, hi=self.max_len)
+            tokens = np.zeros(pad, np.int32)
+            tokens[:suffix] = seq[setup.start:]
+            fn = self._paged_prefill_fn(pad)
+            row = self._paging.allocator.table[req.slot]
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(row), jnp.asarray(tokens),
+                                    jnp.int32(plen),
+                                    jnp.int32(setup.start))
+            self._paging.register_prefill(req.slot, req.prompt)
+        else:
+            pad = _pad_to_pow2(plen, hi=self.max_len)
+            tokens = np.zeros(pad, np.int32)
+            tokens[:plen] = seq
+            fn = self._prefill_fn(pad)
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(tokens), jnp.int32(plen),
+                                    jnp.int32(req.slot))
         metrics.inc("serve.prefills")
         now = self.clock()
         token = self._pick(np.asarray(logits))
@@ -519,23 +669,37 @@ class ServeEngine:
         harsher ordering for the parity gate)."""
         now = self.clock()
         for req, swap in self.scheduler.evict_deadline(now=now):
+            self._release_pages(req)
             self._apply_swap(swap)
             self.finished.append(req)
             metrics.inc("serve.requests.evicted")
             if self.journal is not None:
                 self.journal.record_finish(req)
 
-        for req in self.scheduler.admit():
+        gate = self._admission_gate if self.paged else None
+        for req in self.scheduler.admit(gate=gate):
             self._prefill(req)
         metrics.set_gauge("serve.queue.depth", self.scheduler.queue_depth())
 
         n = self.scheduler.num_active
+        if self.paged:
+            self._paging.note_usage()
         if n == 0:
             if self.journal is not None:
                 self.journal.flush()
             return 0
         bucket = self.scheduler.bucket()
         metrics.observe_value("serve.batch.occupancy", n / bucket)
+        if self.paged:
+            # Host-side page bookkeeping for this round's appends: cross
+            # a page boundary -> allocate the next page (covered by the
+            # admission reservation); tail page shared with the prefix
+            # cache -> copy-on-write it private before the scatter.
+            for req in self.scheduler.active():
+                for src, dst in self._paging.prepare_append(
+                        req.slot, int(self._lengths[req.slot])):
+                    self.cache = self._copy_fn(self.cache, jnp.int32(src),
+                                               jnp.int32(dst))
         t0 = self.clock()
         timer = None
         if self.stall_timeout_s is not None:
@@ -546,9 +710,15 @@ class ServeEngine:
             timer.daemon = True
             timer.start()
         try:
-            self.cache, logits = self._decode_fn(bucket)(
-                self.params, self.cache, jnp.asarray(self._tokens),
-                jnp.asarray(self._lengths))
+            if self.paged:
+                self.cache, logits = self._paged_decode_fn(bucket)(
+                    self.params, self.cache,
+                    jnp.asarray(self._paging.allocator.table),
+                    jnp.asarray(self._tokens), jnp.asarray(self._lengths))
+            else:
+                self.cache, logits = self._decode_fn(bucket)(
+                    self.params, self.cache, jnp.asarray(self._tokens),
+                    jnp.asarray(self._lengths))
             if self.fault_injector is not None:
                 # Inside the watchdog window on purpose: a decode_stall
                 # fault must look exactly like a hung runtime call.

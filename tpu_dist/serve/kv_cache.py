@@ -149,13 +149,32 @@ def build_plan(model: Sequential) -> DecodePlan:
 
 
 def init_cache(plan: DecodePlan, *, max_batch: int, max_len: int,
-               dtype=jnp.float32) -> dict:
+               dtype=jnp.float32, budget_bytes: Optional[int] = None) -> dict:
     """Zeros cache pytree: ``k``/``v`` of
-    ``[num_layers, max_batch, num_heads, max_len, key_dim]``."""
+    ``[num_layers, max_batch, num_heads, max_len, key_dim]``.
+
+    ``budget_bytes`` turns the advisory :func:`cache_nbytes` math into a
+    hard guard: when the cache would not fit, raise a loud error naming
+    how many slots DO fit instead of letting XLA OOM at first prefill.
+    """
     if max_len > plan.max_position:
         raise ValueError(
             f"max_len {max_len} exceeds the model's positional table "
             f"({plan.max_position})")
+    if budget_bytes is not None:
+        need = cache_nbytes(plan, max_batch=max_batch, max_len=max_len,
+                            dtype=dtype)
+        if need > budget_bytes:
+            per_slot = need // max_batch
+            fits = int(budget_bytes // per_slot)
+            raise ValueError(
+                f"serve: contiguous KV cache needs {need} B for "
+                f"{max_batch} slots x {max_len} positions but "
+                f"budget_bytes={budget_bytes} — at this max_len the "
+                f"budget fits {fits} slot(s). Lower max_batch/max_len, "
+                "raise the budget, or switch to the paged cache "
+                "(ServeEngine(paged=True)), which allocates per page "
+                "instead of max_len per slot.")
     shape = (plan.num_layers, max_batch, plan.num_heads, max_len,
              plan.key_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
@@ -332,4 +351,251 @@ def swap_slots(cache: dict, i, j):
         ri = jnp.take(a, i, axis=1)
         rj = jnp.take(a, j, axis=1)
         out[name] = a.at[:, i].set(rj).at[:, j].set(ri)
+    return out
+
+
+# -- paged cache --------------------------------------------------------------
+#
+# The paged variant replaces the contiguous [layers, slots, heads, max_len,
+# key_dim] preallocation with a pool of fixed-size pages — [layers,
+# num_pages + 1, heads, page_size, key_dim] — addressed through a per-slot
+# page table of page indices (host-managed by serve/paging.py). Row
+# ``num_pages`` is a reserved scratch page: every index a program might
+# compute for an invalid position (prompt padding, inactive decode slots
+# whose stale page-table rows could otherwise alias pages reallocated to
+# other requests) is routed there, so garbage writes land where nothing
+# ever reads. A key at flattened gather position j of a slot's table is
+# absolute sequence position j, so the contiguous validity mask
+# ``arange <= pos`` carries over unchanged and the paged math stays
+# allclose-equal to the contiguous path (tests pin it).
+
+
+def page_nbytes(plan: DecodePlan, *, page_size: int,
+                dtype=jnp.float32) -> int:
+    """HBM one page pins across every layer, k and v."""
+    n = 2 * plan.num_layers * plan.num_heads * page_size * plan.key_dim
+    return n * jnp.dtype(dtype).itemsize
+
+
+def page_pool_nbytes(plan: DecodePlan, *, num_pages: int, page_size: int,
+                     dtype=jnp.float32) -> int:
+    """HBM the pool will pin, scratch page included."""
+    return page_nbytes(plan, page_size=page_size, dtype=dtype) \
+        * (num_pages + 1)
+
+
+def pages_for_budget(plan: DecodePlan, *, page_size: int, budget_bytes: int,
+                     dtype=jnp.float32) -> int:
+    """Largest ``num_pages`` whose pool (plus scratch) fits the budget."""
+    per = page_nbytes(plan, page_size=page_size, dtype=dtype)
+    return max(int(budget_bytes // per) - 1, 0)
+
+
+def init_page_pool(plan: DecodePlan, *, num_pages: int, page_size: int,
+                   dtype=jnp.float32,
+                   budget_bytes: Optional[int] = None) -> dict:
+    """Zeros page pool pytree: ``k``/``v`` of
+    ``[num_layers, num_pages + 1, num_heads, page_size, key_dim]`` —
+    the extra row is the write-off scratch page.
+
+    Like :func:`init_cache`, ``budget_bytes`` raises a loud sizing error
+    (how many pages DO fit) instead of deferring to an XLA OOM.
+    """
+    if num_pages < 1:
+        raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    if budget_bytes is not None:
+        need = page_pool_nbytes(plan, num_pages=num_pages,
+                                page_size=page_size, dtype=dtype)
+        if need > budget_bytes:
+            fits = pages_for_budget(plan, page_size=page_size,
+                                    budget_bytes=budget_bytes, dtype=dtype)
+            raise ValueError(
+                f"serve: page pool needs {need} B for {num_pages} pages of "
+                f"{page_size} positions (plus the scratch page) but "
+                f"budget_bytes={budget_bytes} — the budget fits {fits} "
+                "page(s). Lower num_pages/page_size or raise the budget.")
+    shape = (plan.num_layers, num_pages + 1, plan.num_heads, page_size,
+             plan.key_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _gather_pages(pool_arr, layer_idx: int, page_rows):
+    """Flatten one layer's pages into position order.
+
+    ``pool_arr``: ``[L, P, H, ps, dk]``; ``page_rows``: int32
+    ``[..., max_pages]`` page-table row(s). Returns
+    ``[..., H, max_pages * ps, dk]`` where flattened index j holds
+    absolute position j of that slot's sequence (table entries are
+    position-ordered; unallocated entries point at scratch, whose
+    garbage the caller's validity mask never admits).
+    """
+    g = pool_arr[layer_idx][page_rows]     # [..., max_pages, H, ps, dk]
+    g = jnp.moveaxis(g, -3, -4)            # [..., H, max_pages, ps, dk]
+    *lead, h, mp, ps, dk = g.shape
+    return g.reshape(*lead, h, mp * ps, dk)
+
+
+def paged_prefill(plan: DecodePlan, params, pool: dict, page_row, tokens,
+                  length, start):
+    """Causal forward over the UNCACHED suffix of one prompt, writing
+    K/V through the page table.
+
+    With a prefix-cache hit the first ``start`` positions' K/V already
+    sit in (shared) pages referenced by ``page_row``; only the suffix is
+    computed. The suffix queries attend over the gathered cached prefix
+    plus their own causally-masked keys, so the result is numerically
+    identical to a full prefill — cached K/V are exactly what the full
+    forward would recompute. ``start=0`` is the cold path; one compiled
+    program per padded suffix length serves both.
+
+    Args:
+      pool: page pool from :func:`init_page_pool`.
+      page_row: int32 ``[max_pages]`` — this slot's page-table row.
+        Entries covering ``[0, length)`` must be real pages (suffix
+        pages writable, i.e. unshared); the rest point at scratch.
+      tokens: int32 ``[pad]`` — suffix tokens for absolute positions
+        ``start .. length - 1``, padded past ``length - start``.
+      length: scalar int32 total valid positions (prefix + suffix).
+      start: scalar int32 cached-prefix length (``< length``).
+
+    Returns:
+      ``(pool, last_logits)`` — logits ``[vocab]`` of position
+      ``length - 1``.
+    """
+    num_pages = pool["k"].shape[1] - 1     # last row is scratch
+    ps = pool["k"].shape[3]
+    max_pages = page_row.shape[0]
+    pad = tokens.shape[0]
+    x = tokens[None]                       # [1, pad]
+    suffix = length - start
+    pos = start + jnp.arange(pad)          # absolute positions [pad]
+    valid_q = jnp.arange(pad) < suffix     # [pad]
+    key_pos = jnp.arange(max_pages * ps)
+    residuals: list = []
+    for op in plan.ops:
+        tag = op[0]
+        if tag == "res_start":
+            residuals.append(x)
+        elif tag == "res_end":
+            x = _activation(op[1])(residuals.pop() + x)
+        elif tag == "pos":
+            _, layer, path = op
+            table = _params_at(params, path)["table"]
+            at = jnp.minimum(pos, table.shape[0] - 1)
+            x = x + table[at].astype(x.dtype)[None]
+        elif tag == "attn":
+            _, layer, path, idx = op
+            p = _params_at(params, path)
+            q, k, v = _qkv(layer, p, x)    # [1, H, pad, dk]
+            dt = pool["k"].dtype
+            # Scatter each suffix position into (its page, its offset);
+            # padding positions are routed to the scratch page.
+            pg = jnp.where(
+                valid_q,
+                page_row[jnp.minimum(pos // ps, max_pages - 1)],
+                num_pages)                 # [pad]
+            off = pos % ps
+            for name, new in (("k", k), ("v", v)):
+                pool[name] = pool[name].at[idx, pg, :, off, :].set(
+                    jnp.moveaxis(new.astype(dt)[0], 1, 0))  # [pad, H, dk]
+            keys = _gather_pages(pool["k"], idx, page_row)  # [H, S, dk]
+            vals = _gather_pages(pool["v"], idx, page_row)
+            scale = 1.0 / math.sqrt(layer.key_dim)
+            s = jnp.einsum("hqd,hkd->hqk", q[0].astype(jnp.float32),
+                           keys.astype(jnp.float32)) * scale
+            # Key j is position j: <= the query's own absolute position
+            # covers both causality and prefix validity in one mask.
+            mask = key_pos[None, :] <= pos[:, None]         # [pad, S]
+            s = jnp.where(mask[None], s, -jnp.inf)
+            prob = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("hqk,hkd->hqd", prob,
+                             vals.astype(jnp.float32))
+            x = _attn_out(layer, p, out.astype(q.dtype)[None])
+        else:  # "embed" / "point"
+            _, layer, path = op
+            x, _ = layer.apply(_params_at(params, path), {}, x)
+    # x: [1, pad, vocab]; last valid suffix position is suffix - 1.
+    last = jax.lax.dynamic_slice(
+        x, (0, jnp.maximum(suffix - 1, 0), 0), (1, 1, plan.vocab_size))
+    return pool, last[0, 0]
+
+
+def paged_decode_step(plan: DecodePlan, params, pool: dict, page_tables,
+                      tokens, lengths, *, bucket: int):
+    """One generated token per slot through the page tables.
+
+    The new K/V land at offset ``length % page_size`` of the slot's tail
+    page ``page_tables[slot, length // page_size]``; attention then runs
+    over the gathered pages under the same ``arange <= pos`` validity
+    mask as the contiguous path. Inactive slots inside the bucket must
+    have all-scratch table rows so their garbage writes are absorbed.
+
+    Args:
+      page_tables: int32 ``[cap, max_pages]``; only ``[:bucket]`` read.
+      tokens / lengths / bucket: as :func:`decode_step`.
+
+    Returns:
+      ``(pool, logits)`` with logits ``[bucket, vocab]`` fp32.
+    """
+    x = tokens[:bucket][:, None]           # [b, 1]
+    pos = lengths[:bucket]                 # [b]
+    tables = page_tables[:bucket]          # [b, max_pages]
+    ps = pool["k"].shape[3]
+    max_pages = tables.shape[1]
+    rows = jnp.arange(bucket)
+    key_pos = jnp.arange(max_pages * ps)
+    residuals: list = []
+    for op in plan.ops:
+        tag = op[0]
+        if tag == "res_start":
+            residuals.append(x)
+        elif tag == "res_end":
+            x = _activation(op[1])(residuals.pop() + x)
+        elif tag == "pos":
+            _, layer, path = op
+            table = _params_at(params, path)["table"]
+            at = jnp.minimum(pos, table.shape[0] - 1)
+            x = x + table[at].astype(x.dtype)[:, None, :]
+        elif tag == "attn":
+            _, layer, path, idx = op
+            p = _params_at(params, path)
+            q, k, v = _qkv(layer, p, x)    # [b, H, 1, dk]
+            dt = pool["k"].dtype
+            # Tail-page append: inactive slots' rows are all scratch, so
+            # clamping the page-table column keeps the gather in range
+            # and the write lands on the scratch page.
+            pg = tables[rows, jnp.minimum(pos // ps, max_pages - 1)]  # [b]
+            off = pos % ps
+            pool["k"] = pool["k"].at[idx, pg, :, off, :].set(
+                k[:, :, 0, :].astype(dt))
+            pool["v"] = pool["v"].at[idx, pg, :, off, :].set(
+                v[:, :, 0, :].astype(dt))
+            keys = _gather_pages(pool["k"], idx, tables)  # [b, H, S, dk]
+            vals = _gather_pages(pool["v"], idx, tables)
+            scale = 1.0 / math.sqrt(layer.key_dim)
+            s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                           keys.astype(jnp.float32)) * scale
+            valid = key_pos[None, :] <= pos[:, None]      # [b, S]
+            s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+            prob = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", prob,
+                             vals.astype(jnp.float32)).astype(q.dtype)
+            x = _attn_out(layer, p, out)
+        else:  # "embed" / "point"
+            _, layer, path = op
+            x, _ = layer.apply(_params_at(params, path), {}, x)
+    return pool, x[:, 0, :].astype(jnp.float32)  # [b, vocab]
+
+
+def copy_page(pool: dict, src, dst):
+    """Copy page row ``src`` over ``dst`` (every layer, k and v) — the
+    device half of copy-on-write: the allocator clones a shared
+    prefix-cache page into a private one the moment a request needs to
+    write into it. ``src``/``dst`` are traced scalars: one compiled
+    program serves every copy."""
+    out = {}
+    for name, a in pool.items():
+        out[name] = a.at[:, dst].set(jnp.take(a, src, axis=1))
     return out

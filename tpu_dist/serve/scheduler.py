@@ -63,6 +63,11 @@ class Request:
     #: Crash-recovery replays this request has survived (journal replay
     #: counts it each time the request was ACTIVE when the engine died).
     replays: int = 0
+    #: The slot this request occupied when :meth:`Scheduler.finish`
+    #: released it (``slot`` itself is cleared to -1 there). The paged
+    #: engine reads this to free the right page-table row; None until
+    #: the request has held — and left — a slot.
+    released_slot: Optional[int] = None
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -156,14 +161,23 @@ class Scheduler:
 
     # -- admission ------------------------------------------------------------
 
-    def admit(self) -> list[Request]:
+    def admit(self, *, gate=None) -> list[Request]:
         """Move queued requests into free slots (FIFO); returns the newly
         admitted requests, each with ``slot`` assigned — the engine owes
-        each one a prefill before the next decode step."""
+        each one a prefill before the next decode step.
+
+        ``gate`` (optional ``fn(req) -> bool``) is consulted before each
+        admission and stops the round on the first False — the paged
+        engine's free-page-headroom check, which replaces "is a slot
+        free" as the real capacity question. FIFO order is preserved:
+        a gated-out head request blocks those behind it (no reordering,
+        no starvation inversion)."""
         if self.policy == "static" and self.num_active > 0:
             return []  # static cohorts run to completion before refilling
         admitted = []
         while self.queue and self.num_active < self.max_batch:
+            if gate is not None and not gate(self.queue[0]):
+                break
             req = self.queue.pop(0)
             req.slot = self.num_active
             req.status = ACTIVE
@@ -221,6 +235,7 @@ class Scheduler:
             raise ValueError(f"request {req.rid} does not own slot {slot}")
         req.status = status
         req.finish_s = now
+        req.released_slot = slot
         req.slot = -1
         last = self.num_active - 1
         swap = None
