@@ -257,10 +257,26 @@ class TestEpochRendezvous:
         for epoch in range(3):
             bootstrap.epoch_rendezvous(tmp_path, epoch=epoch, rank=0,
                                        world=1)
-        names = sorted(p.name for p in tmp_path.glob("epoch-*"))
+        names = sorted(p.name for p in tmp_path.glob("*epoch-*"))
         # Epoch 0 markers (< current-1) are gone; 1 and 2 remain (the
         # previous epoch stays so a slow peer can still observe it).
-        assert names == ["epoch-1.rank-0", "epoch-2.rank-0"]
+        # Markers are namespaced g{generation}a{attempt} so a reformed
+        # gang can never be satisfied by a previous incarnation's files.
+        assert names == ["g0a0.epoch-1.rank-0", "g0a0.epoch-2.rank-0"]
+
+    def test_stale_namespace_markers_are_ignored_and_reaped(self, tmp_path):
+        """A marker left by generation 0 can neither satisfy nor pollute a
+        later generation's barrier at the same epoch — the stale-marker
+        reuse bug the namespacing exists to kill."""
+        bootstrap.epoch_rendezvous(tmp_path, epoch=2, rank=0, world=1,
+                                   namespace="g0a0")
+        with pytest.raises(TimeoutError):
+            bootstrap.epoch_rendezvous(tmp_path, epoch=2, rank=0, world=2,
+                                       timeout_s=0.3, namespace="g1a0")
+        # Rank 0's own g0a0 marker was reaped when it published under g1a0;
+        # the timed-out g1a0 marker was withdrawn so a later retry of the
+        # same barrier starts clean.
+        assert list(tmp_path.glob("*epoch-*")) == []
 
 
 class TestLivenessRejoinWindow:
@@ -282,6 +298,52 @@ class TestLivenessRejoinWindow:
         assert not m._observe([2], now=4.0)  # still inside the window
         assert m._observe([2], now=6.0)
         assert m.failed and m.dead_peers == [2]
+
+    def test_late_rejoin_after_expiry_stays_terminal(self, tmp_path,
+                                                     monkeypatch):
+        """A peer that answers again AFTER its window expired must not
+        clear the failure, resurrect itself out of dead_peers, or log a
+        spurious peer_rejoined — the trainer is already unwinding on
+        raise_if_failed() and a flapping verdict would race it."""
+        log_path = tmp_path / "events.jsonl"
+        monkeypatch.setenv(EVENT_LOG_ENV, str(log_path))
+        m = LivenessMonitor(rejoin_window_s=5.0)
+        assert not m._observe([2], now=0.0)
+        assert m._observe([2], now=6.0)  # window expired: terminal
+        # Late answer: observe must stay terminal and mutate nothing.
+        assert m._observe([], now=7.0)
+        assert m.failed and m.dead_peers == [2]
+        assert not read_events(log_path, "peer_rejoined")
+        (expired,) = read_events(log_path, "peer_rejoin_expired")
+        assert expired["peers"] == [2]
+
+    def test_overlapping_suspects_expire_independently(self):
+        """Two peers suspected at different times carry different
+        deadlines: only the one past ITS deadline condemns the job, and
+        dead_peers names exactly the expired peer."""
+        m = LivenessMonitor(rejoin_window_s=5.0)
+        assert not m._observe([1], now=0.0)      # deadline 5.0
+        assert not m._observe([1, 2], now=3.0)   # peer 2 deadline 8.0
+        assert sorted(m.suspect_peers) == [1, 2]
+        assert m._observe([1, 2], now=6.0)       # only peer 1 expired
+        assert m.failed and m.dead_peers == [1]
+
+    def test_detect_s_measured_from_last_healthy_round(self):
+        """detect_s = suspicion time minus the peer's last healthy round —
+        the elastic.detect_s observable the chaos report's recovery
+        breakdown is built from. First-ever round has no baseline."""
+        m = LivenessMonitor(rejoin_window_s=5.0)
+        assert not m._observe([3], now=0.0)
+        assert m.last_detect_s is None  # no previous round to anchor on
+        m2 = LivenessMonitor(rejoin_window_s=5.0)
+        assert not m2._observe([], now=0.0)  # healthy round
+        assert not m2._observe([3], now=2.5)
+        assert m2.last_detect_s == pytest.approx(2.5)
+        # A recovered-then-lost peer anchors on its own last answer, not
+        # the round clock.
+        assert not m2._observe([], now=4.0)   # peer 3 answers again
+        assert not m2._observe([3], now=9.0)  # lost again
+        assert m2.last_detect_s == pytest.approx(5.0)
 
 
 def _demo_body(ckdir, epochs: int) -> str:

@@ -1,0 +1,455 @@
+"""Mid-epoch gang reform tests: the gang-generation coordination protocol
+(generation-namespaced rendezvous + reform request/ack/restore files), the
+re-initializable bootstrap layer, the survivor-side StepRejoinGate driven
+through a real ``fit``, the Supervisor's reform flow across a plain-Python
+subprocess gang, and the injector's env-carried rank/incarnation identity
+that makes ``:rankN``/one-shot faults behave under single-process CI gangs.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.cluster import bootstrap
+from tpu_dist.resilience import read_events
+from tpu_dist.resilience.events import EVENT_LOG_ENV, EventLog
+from tpu_dist.resilience.faults import FAULT_PLAN_ENV
+from tpu_dist.resilience.injector import maybe_injector_from_env
+from tpu_dist.resilience.rejoin import (GangReform, StepRejoinGate,
+                                        maybe_step_rejoin_gate)
+from tpu_dist.resilience.supervisor import GracePolicy, Supervisor
+from tpu_dist.training.callbacks import Callback
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def fresh_generation(monkeypatch):
+    """Reset the module-level generation cache and its env mirror so tests
+    that bump the generation can't leak into each other."""
+    monkeypatch.delenv(bootstrap.GENERATION_ENV, raising=False)
+    old = bootstrap._GENERATION
+    bootstrap._GENERATION = None
+    yield
+    bootstrap._GENERATION = old
+
+
+class TestGenerationRendezvous:
+    def test_single_rank_is_immediate(self, tmp_path):
+        assert bootstrap.generation_rendezvous(
+            tmp_path, generation=2, step=48, rank=0, world=1) == [0]
+        assert list(tmp_path.glob("gen-2.step-48.rank-0"))
+
+    def test_two_ranks_meet_across_threads(self, tmp_path):
+        results = {}
+
+        def late_rank():
+            time.sleep(0.2)
+            results[1] = bootstrap.generation_rendezvous(
+                tmp_path, generation=1, step=24, rank=1, world=2,
+                timeout_s=10)
+
+        t = threading.Thread(target=late_rank)
+        t.start()
+        results[0] = bootstrap.generation_rendezvous(
+            tmp_path, generation=1, step=24, rank=0, world=2, timeout_s=10)
+        t.join()
+        assert results[0] == results[1] == [0, 1]
+
+    def test_stale_generation_marker_cannot_satisfy_barrier(self, tmp_path):
+        """A dead generation-0 clique's marker at the SAME step must not
+        count toward generation 1's barrier — the reformed gang would
+        otherwise sail past a rank that never arrived."""
+        (tmp_path / "gen-0.step-24.rank-1").touch()  # dead clique's leftover
+        with pytest.raises(TimeoutError, match=r"missing rank\(s\) \[1\]"):
+            bootstrap.generation_rendezvous(tmp_path, generation=1, step=24,
+                                            rank=0, world=2, timeout_s=0.3)
+
+    def test_timed_out_marker_is_withdrawn(self, tmp_path):
+        with pytest.raises(TimeoutError):
+            bootstrap.generation_rendezvous(tmp_path, generation=1, step=0,
+                                            rank=0, world=2, timeout_s=0.3)
+        # The failed barrier left nothing behind: a later retry (or a
+        # reformed gang at the same coordinate) starts from a clean slate.
+        assert list(tmp_path.glob("gen-1.*rank-0")) == []
+
+    def test_abort_check_raises_out_of_the_wait(self, tmp_path):
+        calls = {"n": 0}
+
+        def abort():
+            calls["n"] += 1
+            if calls["n"] >= 3:
+                raise GangReform({"generation": 1, "lost_ranks": [1]},
+                                 seen_at=time.monotonic())
+
+        with pytest.raises(GangReform):
+            bootstrap.generation_rendezvous(
+                tmp_path, generation=0, step=0, rank=0, world=2,
+                timeout_s=30.0, abort_check=abort)
+
+    def test_reform_acks_survive_marker_gc(self, tmp_path):
+        """Protocol files end in ``rank-N`` too; the marker reaper must
+        never eat a drained-ack the supervisor hasn't read yet."""
+        bootstrap.ack_reform(tmp_path, generation=1, rank=0,
+                             available_step=3)
+        bootstrap.generation_rendezvous(tmp_path, generation=1, step=24,
+                                        rank=0, world=1)
+        assert bootstrap.read_reform_acks(
+            tmp_path, generation=1) == {0: {"rank": 0, "available_step": 3}}
+
+
+class TestReformProtocol:
+    def test_request_ack_restore_roundtrip(self, tmp_path):
+        req = bootstrap.request_reform(tmp_path, generation=1,
+                                       lost_ranks=[2, 1], detect_s=0.5)
+        got = bootstrap.read_reform_request(tmp_path)
+        assert got["generation"] == 1
+        assert got["lost_ranks"] == [1, 2]
+        assert got["detect_s"] == 0.5
+        assert req["generation"] == 1
+        bootstrap.ack_reform(tmp_path, generation=1, rank=0,
+                             available_step=4)
+        bootstrap.ack_reform(tmp_path, generation=1, rank=2,
+                             available_step=None)
+        acks = bootstrap.read_reform_acks(tmp_path, generation=1)
+        assert acks[0]["available_step"] == 4
+        assert acks[2]["available_step"] is None
+        assert bootstrap.read_restore_step(tmp_path, generation=1) == \
+            (False, None)
+        bootstrap.publish_restore_step(tmp_path, generation=1, step=None)
+        assert bootstrap.read_restore_step(tmp_path, generation=1) == \
+            (True, None)
+        bootstrap.publish_restore_step(tmp_path, generation=1, step=4)
+        assert bootstrap.read_restore_step(tmp_path, generation=1) == \
+            (True, 4)
+
+    def test_acks_are_generation_scoped(self, tmp_path):
+        bootstrap.ack_reform(tmp_path, generation=1, rank=0)
+        assert bootstrap.read_reform_acks(tmp_path, generation=2) == {}
+
+    def test_torn_request_reads_as_absent(self, tmp_path):
+        (tmp_path / "reform-request.json").write_text('{"generation"')
+        assert bootstrap.read_reform_request(tmp_path) is None
+
+    def test_generation_file_roundtrip(self, tmp_path):
+        assert bootstrap.read_generation(tmp_path) == 0
+        bootstrap.publish_generation(tmp_path, 3)
+        assert bootstrap.read_generation(tmp_path) == 3
+
+
+class TestReinitialize:
+    def test_single_process_restamps_generation(self, fresh_generation):
+        assert bootstrap.current_generation() == 0
+        assert bootstrap.reinitialize() == 1
+        assert bootstrap.current_generation() == 1
+        assert os.environ[bootstrap.GENERATION_ENV] == "1"
+
+    def test_explicit_generation_wins(self, fresh_generation):
+        assert bootstrap.reinitialize(generation=5) == 5
+        assert bootstrap.current_generation() == 5
+
+    def test_env_seeds_generation_for_relaunched_worker(
+            self, fresh_generation, monkeypatch):
+        monkeypatch.setenv(bootstrap.GENERATION_ENV, "2")
+        bootstrap._GENERATION = None
+        assert bootstrap.current_generation() == 2
+
+
+class TestStepRejoinGateWiring:
+    def test_absent_without_gang_dir(self, monkeypatch):
+        monkeypatch.delenv(bootstrap.GANG_DIR_ENV, raising=False)
+        assert maybe_step_rejoin_gate(steps_per_epoch=2) is None
+
+    def test_env_coordinates(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(bootstrap.GANG_DIR_ENV, str(tmp_path))
+        monkeypatch.setenv("TPU_DIST_REJOIN_WORLD", "4")
+        monkeypatch.setenv("TPU_DIST_REJOIN_RANK", "3")
+        monkeypatch.setenv("TPU_DIST_REJOIN_TIMEOUT_S", "7.5")
+        gate = maybe_step_rejoin_gate(steps_per_epoch=24)
+        assert isinstance(gate, StepRejoinGate)
+        assert (gate.rank, gate.world) == (3, 4)
+        assert gate.timeout_s == 7.5
+
+    def test_batch_end_raises_on_newer_generation(self, tmp_path,
+                                                  fresh_generation):
+        gate = StepRejoinGate(str(tmp_path), rank=0, world=2,
+                              steps_per_epoch=2)
+        gate.on_train_begin()
+        gate.on_batch_end(0, {})  # no request: a cheap no-op
+        bootstrap.request_reform(tmp_path, generation=1, lost_ranks=[1])
+        with pytest.raises(GangReform) as ei:
+            gate.on_batch_end(1, {})
+        assert ei.value.generation == 1 and ei.value.lost_ranks == [1]
+        # Once adopted, the same request stops firing.
+        gate.generation = 1
+        gate.on_batch_end(2, {})
+
+
+class _Reformer(Callback):
+    """Plays the Supervisor from inside a world=1 fit: publishes a reform
+    request (and the consensus restore step) at the first step of epoch 1."""
+
+    wants_batches = True
+
+    def __init__(self, gang_dir, restore_step):
+        self.gang_dir = gang_dir
+        self.restore_step = restore_step
+        self.batches = 0
+        self.fired = False
+
+    def on_batch_end(self, step, logs):
+        self.batches += 1
+        if self.batches == 3 and not self.fired:
+            self.fired = True
+            bootstrap.request_reform(self.gang_dir, generation=1,
+                                     lost_ranks=[1], detect_s=0.01)
+            bootstrap.publish_restore_step(self.gang_dir, generation=1,
+                                           step=self.restore_step)
+
+
+class TestGateSurvivorPathInProcess:
+    """The full survivor side of a reform driven through a real fit
+    (world=1 so the rendezvous is immediate): drain → ack with the
+    available checkpoint → reinitialize at g+1 → restore the consensus
+    step → replay — with EXACT loss parity against an uninterrupted run."""
+
+    def _fit(self, tmp_path, monkeypatch, restore_step, tag):
+        ckpt = tmp_path / f"ckpt-{tag}"
+        gang = tmp_path / f"gang-{tag}"
+        gang.mkdir()
+        log = tmp_path / f"events-{tag}.jsonl"
+        monkeypatch.setenv(bootstrap.GANG_DIR_ENV, str(gang))
+        monkeypatch.setenv("TPU_DIST_REJOIN_WORLD", "1")
+        monkeypatch.setenv("TPU_DIST_REJOIN_RANK", "0")
+        monkeypatch.setenv(EVENT_LOG_ENV, str(log))
+        monkeypatch.delenv("TPU_DIST_RESTORE_STEP", raising=False)
+        model = td.models.build_and_compile_cnn_model(learning_rate=0.01)
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, size=(32,)).astype(np.int32)
+        ds = td.data.Dataset.from_tensor_slices((x, y)).batch(16)
+        hist = model.fit(ds, epochs=3, steps_per_epoch=2, verbose=0,
+                         checkpoint_dir=str(ckpt),
+                         callbacks=[_Reformer(str(gang), restore_step)])
+        return hist, gang, log
+
+    def _baseline(self):
+        model = td.models.build_and_compile_cnn_model(learning_rate=0.01)
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 28, 28, 1).astype(np.float32)
+        y = rng.randint(0, 10, size=(32,)).astype(np.int32)
+        ds = td.data.Dataset.from_tensor_slices((x, y)).batch(16)
+        return model.fit(ds, epochs=3, steps_per_epoch=2,
+                         verbose=0).history["loss"]
+
+    def test_restore_consensus_step_replays_exactly(
+            self, tmp_path, monkeypatch, fresh_generation, eight_devices):
+        baseline = self._baseline()
+        hist, gang, log = self._fit(tmp_path, monkeypatch, restore_step=0,
+                                    tag="restore")
+        # Epoch 1's first attempt was aborted before its on_epoch_end, the
+        # restore landed on step 0, and the replayed epochs 1..2 match the
+        # uninterrupted run bit-for-bit.
+        assert hist.history["loss"] == baseline
+        (ev,) = read_events(log, "gang_reform")
+        assert ev["generation"] == 1 and ev["lost_ranks"] == [1]
+        assert ev["restored_step"] == 0 and ev["next_epoch"] == 1
+        for phase in ("drain_s", "reform_s", "restore_s"):
+            assert ev[phase] >= 0.0
+        # The drained-ack reported epoch 0's published checkpoint.
+        acks = bootstrap.read_reform_acks(gang, generation=1)
+        assert acks[0]["available_step"] == 0
+
+    def test_scratch_consensus_replays_from_epoch_zero(
+            self, tmp_path, monkeypatch, fresh_generation, eight_devices):
+        baseline = self._baseline()
+        hist, _, log = self._fit(tmp_path, monkeypatch, restore_step=None,
+                                 tag="scratch")
+        # Consensus "no common checkpoint": re-init from the seed and
+        # replay everything — epoch 0 appears twice, parity still exact.
+        assert hist.history["loss"] == [baseline[0]] + baseline
+        (ev,) = read_events(log, "gang_reform")
+        assert ev["restored_step"] is None and ev["next_epoch"] == 0
+
+
+def _reform_worker(crash_marker) -> list:
+    """argv for a Supervisor worker speaking the gang-generation protocol
+    directly (no trainer): rank 1 crashes once mid-run; rank 0 survives,
+    acks the reform, and meets the relaunched rank 1 at the generation
+    rendezvous."""
+    body = textwrap.dedent(f"""\
+        import os, sys, time
+
+        from tpu_dist.cluster import bootstrap
+
+        rank = int(os.environ["TPU_DIST_REJOIN_RANK"])
+        gang = os.environ[bootstrap.GANG_DIR_ENV]
+        gen = int(os.environ.get(bootstrap.GENERATION_ENV, "0") or 0)
+        rejoin = int(os.environ.get("TPU_DIST_GANG_REJOIN", "0") or 0)
+        if rank == 1 and not rejoin:
+            time.sleep(0.3)
+            sys.exit(7)  # first life: die mid-epoch
+        if rank == 1:
+            assert os.environ["TPU_DIST_RESTORE_STEP"] == "none"
+            assert gen == 1, gen
+            bootstrap.generation_rendezvous(
+                gang, generation=gen, step=0, rank=1, world=2,
+                timeout_s=30)
+            sys.exit(0)
+        # rank 0 survivor: wait for the reform request ...
+        deadline = time.time() + 30
+        req = None
+        while time.time() < deadline:
+            req = bootstrap.read_reform_request(gang)
+            if req is not None and req["generation"] > gen:
+                break
+            time.sleep(0.05)
+        assert req is not None, "no reform request within 30s"
+        # ... drain-ack it (no checkpoint in this synthetic workload) ...
+        bootstrap.ack_reform(gang, generation=req["generation"], rank=0,
+                             available_step=None)
+        # ... adopt the consensus and meet the relaunched rank.
+        while True:
+            published, step = bootstrap.read_restore_step(
+                gang, generation=req["generation"])
+            if published:
+                break
+            assert time.time() < deadline, "no consensus restore step"
+            time.sleep(0.05)
+        assert step is None, step
+        bootstrap.generation_rendezvous(
+            gang, generation=req["generation"], step=0, rank=0, world=2,
+            timeout_s=30)
+        sys.exit(0)
+    """)
+    return [sys.executable, "-c", body]
+
+
+class TestSupervisorGangReform:
+    def test_lost_rank_is_absorbed_without_gang_restart(self, tmp_path):
+        """The tentpole contract at the Supervisor level: a mid-run rank
+        loss costs ONE replacement spawn — zero restarts for the
+        survivors, one gang_reform, and the reformed clique's generation
+        committed to the gang dir."""
+        gang = tmp_path / "gang"
+        sup = Supervisor(
+            _reform_worker(tmp_path / "crashed-once"),
+            num_workers=2, max_restarts=0,
+            step_rejoin_dir=gang, reform_ack_timeout_s=30.0,
+            env={"PYTHONPATH": str(REPO_ROOT), "JAX_PLATFORMS": "cpu"},
+            log_dir=tmp_path / "logs",
+            event_log=EventLog(tmp_path / "events.jsonl",
+                               role="supervisor"))
+        report = sup.run()
+        assert report.success, report.to_json()
+        assert report.attempts == 1 and report.restarts == 0
+        assert report.outcomes[0].rejoins == 1
+        assert report.outcomes[0].gang_reforms == 1
+        assert report.to_json()["gang_reforms"] == [1]
+        (req,) = read_events(tmp_path / "events.jsonl",
+                             "gang_reform_requested")
+        assert req["generation"] == 1 and req["lost_ranks"] == [1]
+        assert req["detect_s"] >= 0.0 and req["restore_step"] is None
+        (rej,) = read_events(tmp_path / "events.jsonl", "worker_rejoin")
+        assert rej["rank"] == 1
+        assert bootstrap.read_generation(gang) == 1
+
+    def test_ack_timeout_condemns_the_attempt(self, tmp_path):
+        """A survivor that never drains must not wedge the supervisor: the
+        reform aborts after reform_ack_timeout_s and the attempt fails
+        over to the ordinary restart path."""
+        cmd = [sys.executable, "-c", textwrap.dedent("""\
+            import os, sys, time
+
+            rank = int(os.environ["TPU_DIST_REJOIN_RANK"])
+            if rank == 1:
+                time.sleep(0.2)
+                sys.exit(7)
+            time.sleep(30)  # survivor never speaks the protocol
+        """)]
+        sup = Supervisor(
+            cmd, num_workers=2, max_restarts=0,
+            step_rejoin_dir=tmp_path / "gang", reform_ack_timeout_s=1.0,
+            grace=GracePolicy(exit_grace_s=0.3, term_grace_s=5.0),
+            log_dir=tmp_path / "logs",
+            event_log=EventLog(tmp_path / "events.jsonl",
+                               role="supervisor"))
+        report = sup.run()
+        assert not report.success
+        assert report.outcomes[0].gang_reforms == 0
+        (ev,) = read_events(tmp_path / "events.jsonl",
+                            "gang_reform_failed")
+        assert ev["reason"] == "ack_timeout"
+
+
+class TestInjectorGangIdentity:
+    def test_rank_env_override_targets_rankN_faults(self, monkeypatch):
+        """Supervised single-process workers all see process_index()==0;
+        the env-carried gang rank is what lets a ``:rank1`` fault actually
+        arm in rank 1 (and ONLY rank 1)."""
+        monkeypatch.setenv(FAULT_PLAN_ENV, "kill-worker@step30:rank1")
+        monkeypatch.delenv("TPU_DIST_GANG_REJOIN", raising=False)
+        monkeypatch.setenv("TPU_DIST_REJOIN_RANK", "1")
+        assert maybe_injector_from_env(steps_per_epoch=24) is not None
+        monkeypatch.setenv("TPU_DIST_REJOIN_RANK", "0")
+        assert maybe_injector_from_env(steps_per_epoch=24) is None
+
+    def test_rejoin_incarnation_suppresses_one_shot_faults(
+            self, monkeypatch):
+        """A replacement spawned INTO attempt 0 must not re-arm the
+        attempt-0 kill that just killed its predecessor — it would die
+        again forever. The incarnation counter folds into the effective
+        attempt."""
+        monkeypatch.setenv(FAULT_PLAN_ENV, "kill-worker@step30:rank1")
+        monkeypatch.setenv("TPU_DIST_REJOIN_RANK", "1")
+        monkeypatch.setenv("TPU_DIST_GANG_REJOIN", "1")
+        assert maybe_injector_from_env(steps_per_epoch=24) is None
+
+
+class TestStepRejoinCli:
+    def test_step_rejoin_end_to_end(self, tmp_path):
+        """The acceptance demo (scripts/check.sh elastic-rejoin-smoke):
+        kill rank 1 mid-epoch-1, measure recovery from DETECTION for both
+        the status-quo gang restart and the gang-reform rejoin of the SAME
+        fault, and demand the rejoin is strictly cheaper with exact loss
+        parity. The CLI itself rejects vacuous runs (no gang_reform event,
+        survivor restarts, or no speedup → ok=false)."""
+        report_path = tmp_path / "report.json"
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   TPU_DIST_DEMO_STEPS_PER_EPOCH="24")
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_dist.resilience",
+             "--plan", "kill-worker@step30:rank1",
+             "--step-rejoin",
+             "--backoff", "2.0",
+             "--workdir", str(tmp_path / "chaos"),
+             "--report", str(report_path)],
+            capture_output=True, text=True, timeout=420,
+            cwd=str(REPO_ROOT), env=env)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(report_path.read_text())
+        assert report["ok"], report.get("failure")
+        assert report["mode"] == "step_rejoin"
+        ctrl = report["step_rejoin"]["control"]
+        ref = report["step_rejoin"]["reform"]
+        # Control leg recovered by a full gang restart; the reform leg
+        # absorbed the SAME kill with zero restarts and one reform.
+        assert ctrl["restarts"] >= 1
+        assert ref["restarts"] == 0
+        assert sum(ref["gang_reforms"]) >= 1 and sum(ref["rejoins"]) >= 1
+        assert ref["recovery_wall_s"] < ctrl["recovery_wall_s"]
+        assert report["step_rejoin"]["speedup"] > 1.0
+        assert report["loss_delta"] == 0.0  # exact, not approximate
+        bd = report["recovery_breakdown"]
+        for phase in ("detect_s", "drain_s", "reform_s", "restore_s"):
+            assert bd[phase] is not None and bd[phase] >= 0.0, bd
+        assert report["gang_reform_events"]

@@ -35,6 +35,27 @@ logger = logging.getLogger("tpu_dist")
 _STATE_LOCK = threading.Lock()
 _INITIALIZED = False
 _CONFIG: Optional[ClusterConfig] = None
+#: Gang generation of this process's collective clique (see
+#: ``current_generation``); None until first read (env or reinitialize).
+_GENERATION: Optional[int] = None
+
+#: Environment variable carrying the gang generation into a (re)launched
+#: worker — the Supervisor stamps it on a mid-epoch replacement so the new
+#: process joins the REFORMED clique, not the one that lost a member.
+GENERATION_ENV = "TPU_DIST_GANG_GENERATION"
+
+
+def _dist_init(**kwargs):
+    # jax < 0.5 has no heartbeat_timeout_seconds (or other newer)
+    # kwargs on jax.distributed.initialize; drop what this version
+    # doesn't accept rather than failing bring-up.
+    import inspect
+
+    import jax
+
+    sig = inspect.signature(jax.distributed.initialize)
+    jax.distributed.initialize(**{
+        k: v for k, v in kwargs.items() if k in sig.parameters})
 
 
 def initialize(config: ClusterConfig | None = None, *,
@@ -54,17 +75,7 @@ def initialize(config: ClusterConfig | None = None, *,
        (1 worker behaves like single-host MirroredStrategy).
     """
     global _INITIALIZED, _CONFIG
-    import inspect
-
     import jax
-
-    def _dist_init(**kwargs):
-        # jax < 0.5 has no heartbeat_timeout_seconds (or other newer)
-        # kwargs on jax.distributed.initialize; drop what this version
-        # doesn't accept rather than failing bring-up.
-        sig = inspect.signature(jax.distributed.initialize)
-        jax.distributed.initialize(**{
-            k: v for k, v in kwargs.items() if k in sig.parameters})
 
     with _STATE_LOCK:
         if _INITIALIZED:
@@ -165,6 +176,98 @@ def is_initialized() -> bool:
     return _INITIALIZED
 
 
+def current_generation() -> int:
+    """The gang generation this process's collective clique belongs to.
+
+    Generation 0 is the launch clique. Every mid-epoch gang reform bumps it
+    (``reinitialize``); a worker relaunched INTO a reformed gang inherits it
+    through ``$TPU_DIST_GANG_GENERATION`` (stamped by the Supervisor), so
+    survivors and the replacement agree on the clique id without talking.
+    """
+    global _GENERATION
+    with _STATE_LOCK:
+        if _GENERATION is None:
+            try:
+                _GENERATION = int(os.environ.get(GENERATION_ENV, "0") or 0)
+            except ValueError:
+                _GENERATION = 0
+        return _GENERATION
+
+
+def reinitialize(generation: Optional[int] = None, *,
+                 coordinator_port: Optional[int] = None) -> int:
+    """Tear down and re-bring-up the collective clique under a new generation.
+
+    The live-elasticity primitive: a survivor of a lost rank keeps its
+    weights, host state, and python process — only the *clique* is reformed.
+    ``jax.distributed`` is shut down (releasing membership in the dead
+    clique) and re-initialized against a FRESH coordinator port, derived
+    deterministically from the generation (``base_port + generation`` unless
+    ``coordinator_port`` overrides it) so every survivor dials the same new
+    endpoint without communicating — the old coordinator may have died with
+    the lost rank, and its port may sit in TIME_WAIT.
+
+    In single-process mode (including the CI file-gang vehicle, where each
+    supervised worker is its own jax process and the gang exists only in the
+    shared-filesystem rendezvous) there is no clique to tear down: the call
+    just re-stamps the generation, which re-namespaces every subsequent
+    rendezvous marker. Returns the new generation (``generation`` when
+    given, else current + 1).
+    """
+    global _INITIALIZED, _GENERATION
+    import jax
+
+    new_gen = (current_generation() + 1 if generation is None
+               else int(generation))
+    with _STATE_LOCK:
+        was_up = _INITIALIZED
+        config = _CONFIG
+        multi = False
+        if was_up:
+            try:
+                multi = jax.process_count() > 1
+            except RuntimeError:  # pragma: no cover - backend not ready
+                multi = False
+            if multi:
+                try:
+                    jax.distributed.shutdown()
+                except Exception as exc:  # the old clique is already broken
+                    logger.warning(
+                        "tpu_dist: shutdown of generation %d clique failed "
+                        "(%s); continuing with re-init", _GENERATION, exc)
+            _INITIALIZED = False
+        _GENERATION = new_gen
+        # Re-exported so child processes (and a later current_generation()
+        # after module reload) observe the reformed clique's id.
+        os.environ[GENERATION_ENV] = str(new_gen)
+
+    if config is not None and config.num_processes > 1:
+        host, _, base_port = config.coordinator_address.rpartition(":")
+        try:
+            port = (int(coordinator_port) if coordinator_port is not None
+                    else int(base_port) + new_gen)
+        except ValueError:
+            port = coordinator_port or base_port
+        hb = float(os.environ.get("TPU_DIST_HEARTBEAT_TIMEOUT_S", "100"))
+        logger.info(
+            "tpu_dist: reforming %d-process clique at generation %d "
+            "(coordinator %s:%s)", config.num_processes, new_gen, host, port)
+        _dist_init(
+            coordinator_address=f"{host}:{port}",
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+            heartbeat_timeout_seconds=max(1, round(hb)),
+        )
+        _log_bringup()
+    else:
+        logger.info("tpu_dist: gang generation -> %d (single-process "
+                    "clique; rendezvous namespace re-stamped)", new_gen)
+    with _STATE_LOCK:
+        _INITIALIZED = was_up or (config is not None
+                                  and config.num_processes > 1)
+    return new_gen
+
+
 def cluster_config() -> Optional[ClusterConfig]:
     """The parsed TF_CONFIG for this process, if any."""
     return _CONFIG
@@ -221,56 +324,94 @@ def barrier(name: str = "tpu_dist_barrier") -> None:
 #: epoch-boundary rendezvous. Setting it arms ``RejoinGate`` in every fit().
 REJOIN_DIR_ENV = "TPU_DIST_REJOIN_DIR"
 
+#: Environment variable naming the shared directory used for mid-epoch gang
+#: reform (step-granular rendezvous + the reform request/ack protocol).
+#: Setting it arms ``StepRejoinGate`` in every fit().
+GANG_DIR_ENV = "TPU_DIST_GANG_DIR"
 
-def epoch_rendezvous(directory, *, epoch: int, rank: Optional[int] = None,
-                     world: Optional[int] = None, timeout_s: float = 120.0,
-                     poll_s: float = 0.05) -> "list[int]":
-    """Shared-filesystem epoch-boundary barrier for elastic rejoin.
 
-    Each worker atomically publishes a ``epoch-{E}.rank-{r}`` marker under
-    ``directory`` and polls until markers from all ``world`` ranks for that
-    epoch exist, then returns the sorted rank list. This is deliberately NOT
-    ``sync_global_devices``: a worker relaunched after a preemption is a new
-    process outside the surviving gang's collective clique, and the meeting
-    protocol that lets it back in cannot itself require membership. A shared
-    directory (the same assumption the v2 sharded checkpoint already makes)
-    is the lowest-common-denominator rendezvous medium.
+def _default_rendezvous_namespace() -> str:
+    """Generation/attempt namespace for this process's barrier markers.
 
-    Raises :class:`TimeoutError` naming the missing ranks if the gang does
-    not fully assemble within ``timeout_s`` — the caller (usually
-    ``RejoinGate``) surfaces that as a liveness failure rather than stepping
-    with a partial gang.
+    A marker published by generation g / supervisor attempt a must never
+    satisfy a barrier run by generation g' or attempt a' — a dead process's
+    stale marker would let a partial gang pass. Namespacing by both ids
+    makes reuse structurally impossible.
     """
-    import pathlib
-    import time
+    from tpu_dist.resilience.events import current_attempt
 
-    if rank is None:
-        rank = process_index()
-    if world is None:
-        world = process_count()
-    d = pathlib.Path(directory)
-    d.mkdir(parents=True, exist_ok=True)
-    marker = d / f"epoch-{epoch}.rank-{rank}"
-    tmp = d / f".epoch-{epoch}.rank-{rank}.{os.getpid()}.tmp"
-    tmp.write_text(str(os.getpid()), encoding="utf-8")
-    os.replace(tmp, marker)  # atomic publish; re-publishing is idempotent
-    # Markers two epochs back can never be waited on again — reap this
-    # rank's own so a long run does not grow the directory unboundedly.
-    for old in d.glob(f"epoch-*.rank-{rank}"):
-        try:
-            e = int(old.name.split(".", 1)[0].split("-", 1)[1])
-        except ValueError:
+    return f"g{current_generation()}a{current_attempt()}"
+
+
+def _reap_markers(d, rank: int, *, keep_namespace: str,
+                  keep_min_epoch: Optional[int] = None) -> None:
+    """Reap THIS rank's barrier markers that can never be waited on again.
+
+    Removes the rank's markers from any other namespace (older generations/
+    attempts, including legacy un-namespaced ``epoch-N.rank-r`` files from
+    pre-generation runs), and — when ``keep_min_epoch`` is given — markers
+    in the current namespace older than that epoch. Only this rank's own
+    files are touched: another live rank's markers are its own to manage.
+    """
+    for old in d.glob(f"*rank-{rank}"):
+        name = old.name
+        if name.startswith("reform-"):
+            # Reform-protocol acks also end in rank-{r}; they belong to the
+            # supervisor handshake, not the barrier, and are not ours to GC.
             continue
-        if e < epoch - 1:
+        if not name.startswith(keep_namespace + "."):
+            try:
+                old.unlink()
+            except OSError:
+                pass
+            continue
+        if keep_min_epoch is None:
+            continue
+        try:
+            e = int(name.split(".")[1].split("-", 1)[1])
+        except (IndexError, ValueError):
+            continue
+        if e < keep_min_epoch:
             try:
                 old.unlink()
             except OSError:
                 pass
 
+
+def _fs_barrier(directory, *, marker_stem: str, rank: int, world: int,
+                timeout_s: float, poll_s: float, what: str,
+                gc_namespace: Optional[str] = None,
+                gc_min_epoch: Optional[int] = None,
+                abort_check=None) -> "list[int]":
+    """Shared-filesystem barrier: publish ``{marker_stem}.rank-{rank}`` and
+    poll until all ``world`` ranks' markers for the same stem exist.
+
+    On timeout this rank's own marker is reaped BEFORE raising, so a retry
+    of the same barrier (or a reformed gang reusing the coordinate) cannot
+    count this process as present when it has already given up.
+    ``abort_check`` (if given) runs every poll round and may raise to break
+    out — how a survivor parked at an epoch barrier still notices a gang
+    reform whose missing rank will never publish this generation's marker.
+    """
+    import pathlib
+    import time
+
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    marker = d / f"{marker_stem}.rank-{rank}"
+    tmp = d / f".{marker_stem}.rank-{rank}.{os.getpid()}.tmp"
+    tmp.write_text(str(os.getpid()), encoding="utf-8")
+    os.replace(tmp, marker)  # atomic publish; re-publishing is idempotent
+    if gc_namespace is not None:
+        _reap_markers(d, rank, keep_namespace=gc_namespace,
+                      keep_min_epoch=gc_min_epoch)
+
     deadline = time.monotonic() + timeout_s
     while True:
+        if abort_check is not None:
+            abort_check()
         present = set()
-        for p in d.glob(f"epoch-{epoch}.rank-*"):
+        for p in d.glob(f"{marker_stem}.rank-*"):
             suffix = p.name.rsplit("rank-", 1)[1]
             if suffix.isdigit():
                 present.add(int(suffix))
@@ -278,8 +419,225 @@ def epoch_rendezvous(directory, *, epoch: int, rank: Optional[int] = None,
             return sorted(present & set(range(world)))
         if time.monotonic() > deadline:
             missing = sorted(set(range(world)) - present)
+            try:
+                marker.unlink()
+            except OSError:
+                pass
             raise TimeoutError(
-                f"epoch_rendezvous: epoch {epoch} barrier in {d} timed out "
-                f"after {timeout_s:.1f}s; missing rank(s) {missing} "
-                f"(present: {sorted(present)})")
+                f"{what} barrier in {d} timed out after {timeout_s:.1f}s; "
+                f"missing rank(s) {missing} (present: {sorted(present)})")
         time.sleep(poll_s)
+
+
+def epoch_rendezvous(directory, *, epoch: int, rank: Optional[int] = None,
+                     world: Optional[int] = None, timeout_s: float = 120.0,
+                     poll_s: float = 0.05,
+                     namespace: Optional[str] = None) -> "list[int]":
+    """Shared-filesystem epoch-boundary barrier for elastic rejoin.
+
+    Each worker atomically publishes a ``{ns}.epoch-{E}.rank-{r}`` marker
+    under ``directory`` and polls until markers from all ``world`` ranks for
+    that epoch exist, then returns the sorted rank list. This is deliberately
+    NOT ``sync_global_devices``: a worker relaunched after a preemption is a
+    new process outside the surviving gang's collective clique, and the
+    meeting protocol that lets it back in cannot itself require membership.
+    A shared directory (the same assumption the v2 sharded checkpoint already
+    makes) is the lowest-common-denominator rendezvous medium.
+
+    ``namespace`` defaults to ``g{generation}a{attempt}``: markers from an
+    earlier supervisor attempt or an earlier gang generation can never
+    satisfy this barrier, and a rank that times out reaps its own marker, so
+    a restarted gang re-running the same epoch numbers always assembles from
+    scratch (previously a dead process's marker could pass a partial gang).
+
+    Raises :class:`TimeoutError` naming the missing ranks if the gang does
+    not fully assemble within ``timeout_s`` — the caller (usually
+    ``RejoinGate``) surfaces that as a liveness failure rather than stepping
+    with a partial gang.
+    """
+    if rank is None:
+        rank = process_index()
+    if world is None:
+        world = process_count()
+    ns = namespace if namespace is not None else _default_rendezvous_namespace()
+    return _fs_barrier(
+        directory, marker_stem=f"{ns}.epoch-{epoch}", rank=rank, world=world,
+        timeout_s=timeout_s, poll_s=poll_s,
+        what=f"epoch_rendezvous: epoch {epoch} ({ns})",
+        gc_namespace=ns, gc_min_epoch=epoch - 1)
+
+
+def generation_rendezvous(directory, *, generation: int, step: int,
+                          rank: Optional[int] = None,
+                          world: Optional[int] = None,
+                          timeout_s: float = 120.0,
+                          poll_s: float = 0.05,
+                          abort_check=None) -> "list[int]":
+    """Step-granular gang barrier, namespaced by gang generation.
+
+    The mid-epoch generalization of :func:`epoch_rendezvous`: survivors of a
+    lost rank drain at a step boundary and meet the relaunched rank HERE, at
+    an arbitrary global-step coordinate, under the reformed generation's
+    namespace — a marker from the broken generation g can never satisfy
+    generation g+1's barrier. Markers from older generations (and older
+    steps of this generation) published by this rank are reaped on the way
+    in; this rank's marker is reaped on timeout so a retry starts clean.
+    """
+    if rank is None:
+        rank = process_index()
+    if world is None:
+        world = process_count()
+    ns = f"gen-{generation}"
+    return _fs_barrier(
+        directory, marker_stem=f"{ns}.step-{step}", rank=rank, world=world,
+        timeout_s=timeout_s, poll_s=poll_s,
+        what=f"generation_rendezvous: generation {generation} step {step}",
+        gc_namespace=ns, gc_min_epoch=None, abort_check=abort_check)
+
+
+# ---------------------------------------------------------------------------
+# Gang-reform protocol (shared filesystem, torn-read tolerant)
+#
+# The Supervisor and the surviving workers coordinate a mid-epoch reform
+# through three kinds of files under the gang directory:
+#
+#   reform-request.json           supervisor -> survivors: "rank R is lost;
+#                                 drain, publish checkpoints, reform at
+#                                 generation G"
+#   reform-g{G}.drained.rank-{r}  survivor -> supervisor: "my in-flight
+#                                 checkpoint is published; safe to relaunch"
+#   generation                    supervisor -> everyone: the committed
+#                                 current generation (a late-starting or
+#                                 relaunched worker adopts max(env, file))
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write_json(path, payload: dict) -> None:
+    import json
+
+    tmp = path.parent / f".{path.name}.{os.getpid()}.tmp"
+    tmp.write_text(json.dumps(payload), encoding="utf-8")
+    os.replace(tmp, path)
+
+
+def _read_json(path) -> Optional[dict]:
+    import json
+
+    try:
+        return json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, ValueError):
+        return None
+
+
+def request_reform(directory, *, generation: int, lost_ranks: "list[int]",
+                   detect_s: Optional[float] = None) -> dict:
+    """Publish a gang-reform request (supervisor side). Overwrites any older
+    request — at most one reform is in flight per gang directory."""
+    import pathlib
+    import time
+
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "generation": int(generation),
+        "lost_ranks": sorted(int(r) for r in lost_ranks),
+        "ts": time.time(),
+        "detect_s": detect_s,
+    }
+    _atomic_write_json(d / "reform-request.json", payload)
+    return payload
+
+
+def read_reform_request(directory) -> Optional[dict]:
+    """The pending reform request, or None (missing / torn / malformed)."""
+    import pathlib
+
+    req = _read_json(pathlib.Path(directory) / "reform-request.json")
+    if not isinstance(req, dict) or "generation" not in req:
+        return None
+    return req
+
+
+def ack_reform(directory, *, generation: int, rank: int,
+               available_step: Optional[int] = None) -> None:
+    """Survivor's drained-and-published acknowledgement for a reform.
+
+    ``available_step`` is the newest COMPLETE checkpoint step in this rank's
+    checkpoint directory after the drain published in-flight saves — the
+    supervisor takes the gang-wide minimum as the consensus restore step
+    (per-rank checkpoint directories can legitimately disagree by an epoch
+    or two: ranks are only loosely coupled between barriers, and the dead
+    rank's async save may not have published before it died).
+    """
+    import pathlib
+
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    _atomic_write_json(d / f"reform-g{int(generation)}.drained.rank-{int(rank)}",
+                       {"rank": int(rank), "available_step": available_step})
+
+
+def read_reform_acks(directory, *, generation: int) -> "dict[int, dict]":
+    """Reform acks at ``generation``: rank -> ack payload (torn reads skip)."""
+    import pathlib
+
+    d = pathlib.Path(directory)
+    acks: dict = {}
+    for p in d.glob(f"reform-g{int(generation)}.drained.rank-*"):
+        suffix = p.name.rsplit("rank-", 1)[1]
+        if not suffix.isdigit():
+            continue
+        payload = _read_json(p)
+        if isinstance(payload, dict):
+            acks[int(suffix)] = payload
+    return acks
+
+
+def publish_restore_step(directory, *, generation: int,
+                         step: Optional[int]) -> None:
+    """Commit the consensus restore step for a reform (supervisor side).
+
+    ``None`` means no checkpoint is common to the whole reformed gang —
+    every rank re-initializes from the seed and replays from epoch 0 (the
+    same exactness argument as rollback-and-replay without a checkpoint).
+    """
+    import pathlib
+
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    _atomic_write_json(d / f"reform-g{int(generation)}.restore",
+                       {"step": step})
+
+
+def read_restore_step(directory, *, generation: int) -> "tuple[bool, Optional[int]]":
+    """``(published, step)`` for the reform's consensus restore step."""
+    import pathlib
+
+    obj = _read_json(pathlib.Path(directory)
+                     / f"reform-g{int(generation)}.restore")
+    if not isinstance(obj, dict) or "step" not in obj:
+        return (False, None)
+    step = obj["step"]
+    return (True, int(step) if step is not None else None)
+
+
+def publish_generation(directory, generation: int) -> None:
+    """Commit ``generation`` as the gang's current generation (supervisor)."""
+    import pathlib
+
+    d = pathlib.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    tmp = d / f".generation.{os.getpid()}.tmp"
+    tmp.write_text(str(int(generation)), encoding="utf-8")
+    os.replace(tmp, d / "generation")
+
+
+def read_generation(directory) -> int:
+    """The committed gang generation for ``directory`` (0 when unset)."""
+    import pathlib
+
+    try:
+        return int((pathlib.Path(directory) / "generation")
+                   .read_text(encoding="utf-8").strip())
+    except (OSError, ValueError):
+        return 0

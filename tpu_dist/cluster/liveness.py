@@ -230,6 +230,17 @@ class LivenessMonitor:
         self._failed = threading.Event()
         #: peer id -> monotonic deadline by which it must answer again.
         self._suspects: dict = {}
+        #: peer id -> monotonic time it last answered a probe; basis for
+        #: ``detect_s`` (how much of the heartbeat window a detection ate).
+        self._last_seen: dict = {}
+        #: Detection latency of the most recent new suspect, seconds; the
+        #: ``elastic.detect_s`` observable — on a real backend this is
+        #: dominated by $TPU_DIST_HEARTBEAT_TIMEOUT_S (default 100 s) and
+        #: was invisible before it was recorded here.
+        self.last_detect_s: Optional[float] = None
+        #: monotonic time of the previous _observe round — the fallback
+        #: "last known alive" for a peer that was never individually seen.
+        self._prev_round_t: Optional[float] = None
 
     def start(self) -> "LivenessMonitor":
         import jax
@@ -289,6 +300,11 @@ class LivenessMonitor:
         from tpu_dist.resilience import events
 
         now = time.monotonic() if now is None else now
+        if self._failed.is_set():
+            # Terminal guard: once condemned, a late-answering peer must not
+            # clear suspicions or log a spurious peer_rejoined — the trainer
+            # is already unwinding on raise_if_failed().
+            return True
         dead_set = set(dead)
         if self.rejoin_window_s <= 0 and dead_set:
             self._dead_peers = sorted(dead_set)
@@ -300,15 +316,25 @@ class LivenessMonitor:
             return True
         # Rejoin window armed: newly-dead peers become suspects ...
         for peer in dead_set - set(self._suspects):
+            base = self._last_seen.get(peer, self._prev_round_t)
+            detect_s = None if base is None else max(0.0, now - base)
+            self.last_detect_s = detect_s
+            if detect_s is not None:
+                from tpu_dist.observe import metrics as metrics_lib
+
+                metrics_lib.observe_value("elastic.detect_s", detect_s)
             self._suspects[peer] = now + self.rejoin_window_s
             logger.warning(
                 "peer %d unreachable; suspect for %.0fs pending rejoin",
                 peer, self.rejoin_window_s)
-            events.maybe_log("peer_suspect", peer=peer,
-                             rejoin_window_s=self.rejoin_window_s)
+            events.maybe_log(
+                "peer_suspect", peer=peer,
+                rejoin_window_s=self.rejoin_window_s,
+                detect_s=None if detect_s is None else round(detect_s, 6))
         # ... answering suspects recover ...
         for peer in sorted(set(self._suspects) - dead_set):
             del self._suspects[peer]
+            self._last_seen[peer] = now
             logger.info("peer %d answered again; rejoin complete", peer)
             events.maybe_log("peer_rejoined", peer=peer)
         # ... and suspects past their deadline condemn the job.
@@ -321,6 +347,7 @@ class LivenessMonitor:
                 "restart the job", expired, self.rejoin_window_s)
             events.maybe_log("peer_rejoin_expired", peers=expired)
             return True
+        self._prev_round_t = now
         return False
 
     @property

@@ -29,6 +29,7 @@ __all__ = [
     "FaultPlan", "FaultSpec", "describe",
     "FaultInjector", "maybe_injector_from_env",
     "BackoffPolicy", "Supervisor", "SupervisorReport",
+    "GangReform", "StepRejoinGate", "maybe_step_rejoin_gate",
 ]
 
 _LAZY = {
@@ -37,6 +38,9 @@ _LAZY = {
     "BackoffPolicy": "tpu_dist.resilience.supervisor",
     "Supervisor": "tpu_dist.resilience.supervisor",
     "SupervisorReport": "tpu_dist.resilience.supervisor",
+    "GangReform": "tpu_dist.resilience.rejoin",
+    "StepRejoinGate": "tpu_dist.resilience.rejoin",
+    "maybe_step_rejoin_gate": "tpu_dist.resilience.rejoin",
 }
 
 

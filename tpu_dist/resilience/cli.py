@@ -67,12 +67,16 @@ def _worker_cmd() -> list:
 def _clean_env(extra: dict) -> dict:
     """os.environ minus any resilience/observe wiring from OUR caller, plus
     ``extra`` — each run (baseline, chaos) gets exactly its own knobs."""
+    from tpu_dist.cluster import bootstrap
     from tpu_dist.observe.telemetry import OBSERVE_DIR_ENV
 
     env = {k: v for k, v in os.environ.items()
            if k not in (FAULT_PLAN_ENV, events.EVENT_LOG_ENV,
                         events.ATTEMPT_ENV, CHECKPOINT_DIR_ENV,
-                        OBSERVE_DIR_ENV)
+                        OBSERVE_DIR_ENV, bootstrap.REJOIN_DIR_ENV,
+                        bootstrap.GANG_DIR_ENV, bootstrap.GENERATION_ENV,
+                        "TPU_DIST_GANG_REJOIN", "TPU_DIST_RESTORE_STEP",
+                        "TPU_DIST_REJOIN_RANK", "TPU_DIST_REJOIN_WORLD")
            and not k.startswith("TPU_DIST_INTEGRITY")}
     env.update(extra)
     return env
@@ -108,6 +112,172 @@ def _parse_reshape(arg: Optional[str]) -> Optional[list]:
     return counts
 
 
+def _supervised_leg(args, plan, leg_dir: pathlib.Path, *, workers: int,
+                    step_rejoin: bool):
+    """One supervised chaos run in ``leg_dir``; returns (sup, report, events).
+
+    Both legs of the step-rejoin comparison run through here with identical
+    knobs except ``step_rejoin`` — the control recovers the ISSUE's status
+    quo way (gang restart), the reform leg via mid-epoch rejoin — so their
+    recovery_wall_s difference measures exactly the mechanism under test.
+    Checkpoint dirs are rank-scoped: each single-process worker believes it
+    is the chief, and two async writers must not race one staging dir.
+    """
+    leg_dir.mkdir(parents=True, exist_ok=True)
+    event_path = leg_dir / "events.jsonl"
+    extra_env = {
+        FAULT_PLAN_ENV: plan.dumps(),
+        events.EVENT_LOG_ENV: str(event_path),
+        CHECKPOINT_DIR_ENV: str(leg_dir / "ckpt"),
+    }
+    if args.entry:
+        extra_env[ENTRY_ENV] = args.entry
+    sup = Supervisor(
+        _worker_cmd(), num_workers=workers,
+        max_restarts=args.max_restarts, attempt_deadline_s=args.deadline,
+        backoff=BackoffPolicy(initial_s=args.backoff),
+        env=_clean_env(extra_env), log_dir=leg_dir / "logs",
+        event_log=events.EventLog(event_path, role="supervisor"),
+        observe_dir=leg_dir / "observe",
+        step_rejoin_dir=(leg_dir / "gang") if step_rejoin else None,
+        rank_scoped_env_keys=(CHECKPOINT_DIR_ENV,))
+    return sup, sup.run(), event_path
+
+
+def _run_step_rejoin(args, plan, workdir: pathlib.Path) -> int:
+    """The mid-epoch rejoin experiment: baseline, control (gang restart),
+    reform (gang-generation rejoin); gates per ISSUE acceptance criteria."""
+    workers = max(2, args.workers)
+    baseline = None
+    if not args.no_baseline:
+        print("running baseline (no faults)...", file=sys.stderr)
+        # Pin the baseline to the SAME device env the gang workers get
+        # (supervisor multi-worker branch forces 1 device per process) —
+        # an inherited XLA_FLAGS device count would compare losses across
+        # different meshes and fail the exact-parity gate spuriously.
+        baseline = run_baseline(workdir, timeout=args.timeout, extra_env={
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PALLAS_AXON_POOL_IPS": "",
+        })
+
+    print(f"running control leg (gang restart, {workers} workers)...",
+          file=sys.stderr)
+    control_sup, control, control_events = _supervised_leg(
+        args, plan, workdir / "control", workers=workers, step_rejoin=False)
+    print("running reform leg (mid-epoch rejoin)...", file=sys.stderr)
+    reform_sup, reform, reform_events = _supervised_leg(
+        args, plan, workdir / "reform", workers=workers, step_rejoin=True)
+
+    final = None
+    if reform.success:
+        final = parse_result_line(reform_sup.worker_log(
+            reform.attempts - 1, 0).read_text(errors="replace"))
+
+    control_json = control.to_json()
+    reform_json = reform.to_json()
+    reforms = events.read_events(reform_events, "gang_reform")
+    reform_requests = events.read_events(reform_events,
+                                         "gang_reform_requested")
+    rejoins = events.read_events(reform_events, "worker_rejoin")
+    fired_control = events.read_events(control_events, "fault_fired")
+    fired_reform = events.read_events(reform_events, "fault_fired")
+
+    # Phase-split recovery accounting: detection comes from the supervisor
+    # (it watches the gang), drain/reform/restore from the survivors'
+    # gang_reform events — worst rank, since the gang moves at its pace.
+    def _worst(records, key):
+        vals = [r.get(key) for r in records
+                if isinstance(r.get(key), (int, float))]
+        return round(max(vals), 6) if vals else None
+
+    breakdown = {
+        "detect_s": _worst(reform_requests, "detect_s"),
+        "drain_s": _worst(reforms, "drain_s"),
+        "reform_s": _worst(reforms, "reform_s"),
+        "restore_s": _worst(reforms, "restore_s"),
+    }
+
+    report = {
+        "plan": plan.to_json(),
+        "mode": "step_rejoin",
+        "workdir": str(workdir),
+        "success": control.success and reform.success,
+        "step_rejoin": {
+            "control": {
+                "recovery_wall_s": control_json["recovery_wall_s"],
+                "wall_time_s": control_json["wall_time_s"],
+                "restarts": control.restarts,
+                "attempts": control.attempts,
+                "exit_codes": control_json["exit_codes"],
+                "exit_kinds": control_json["exit_kinds"],
+            },
+            "reform": {
+                "recovery_wall_s": reform_json["recovery_wall_s"],
+                "wall_time_s": reform_json["wall_time_s"],
+                "restarts": reform.restarts,
+                "attempts": reform.attempts,
+                "exit_codes": reform_json["exit_codes"],
+                "exit_kinds": reform_json["exit_kinds"],
+                "rejoins": reform_json["rejoins"],
+                "gang_reforms": reform_json["gang_reforms"],
+            },
+        },
+        "recovery_wall_s": reform_json["recovery_wall_s"],
+        "recovery_breakdown": breakdown,
+        "gang_reform_events": len(reforms),
+        "final_loss": (final or {}).get("final_loss"),
+    }
+
+    ok = control.success and reform.success
+    failures = []
+    if not fired_control or not fired_reform:
+        failures.append("no fault fired — vacuous chaos run")
+    if reform.restarts != 0:
+        failures.append(
+            f"reform leg leaned on a gang restart (restarts="
+            f"{reform.restarts}) instead of a mid-epoch rejoin")
+    if not reforms:
+        failures.append("no gang_reform event — vacuous rejoin run")
+    if not rejoins:
+        failures.append("no worker_rejoin — the lost rank never relaunched")
+    ctrl_rec = control_json["recovery_wall_s"]
+    ref_rec = reform_json["recovery_wall_s"]
+    if ctrl_rec is None or ref_rec is None:
+        failures.append("missing recovery_wall_s in a leg")
+    elif not ref_rec < ctrl_rec:
+        failures.append(
+            f"rejoin recovery ({ref_rec:.3f}s) not strictly below "
+            f"gang-restart recovery ({ctrl_rec:.3f}s)")
+    else:
+        report["step_rejoin"]["speedup"] = round(ctrl_rec / ref_rec, 3)
+    if baseline is not None:
+        report["baseline_final_loss"] = baseline.get("final_loss")
+        if (report["final_loss"] is None
+                or report["baseline_final_loss"] is None):
+            failures.append("missing final loss for the parity check")
+            report["parity_ok"] = False
+        else:
+            delta = abs(report["final_loss"]
+                        - report["baseline_final_loss"])
+            report["loss_delta"] = delta
+            # EXACT parity: the reform replays from the consensus
+            # checkpoint with epoch-keyed RNG — bit-identical, not merely
+            # close, so no atol.
+            report["parity_ok"] = delta == 0.0
+            if delta != 0.0:
+                failures.append(f"loss parity not exact (delta={delta})")
+    if failures:
+        ok = False
+        report["failure"] = "; ".join(failures)
+    report["ok"] = ok
+    out = json.dumps(report, indent=2)
+    print(out)
+    if args.report:
+        pathlib.Path(args.report).write_text(out + "\n")
+    return 0 if ok else 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m tpu_dist.resilience",
@@ -140,6 +310,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="skip the baseline run (no parity check)")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="overall per-run timeout for the baseline")
+    p.add_argument("--step-rejoin", action="store_true",
+                   help="mid-epoch gang-reform scenario: run the SAME kill "
+                        "plan twice on a >= 2-worker gang — once recovering "
+                        "by full gang restart (the control), once by "
+                        "mid-epoch worker rejoin under a reformed gang "
+                        "generation — and gate on rejoin recovery_wall_s "
+                        "strictly below the control's, zero survivor "
+                        "restarts, >= 1 gang_reform event, and EXACT loss "
+                        "parity (delta 0.0) vs the fault-free baseline")
     p.add_argument("--reshape", default=None, metavar="N,M[,...]",
                    help="elastic reshape schedule: attempt k runs on the "
                         "k-th device count (last repeats), e.g. 8,4 = die "
@@ -163,6 +342,13 @@ def main(argv: Optional[list] = None) -> int:
     print(f"chaos workdir: {workdir}", file=sys.stderr)
     for line in describe(plan):
         print(f"fault: {line}", file=sys.stderr)
+
+    if args.step_rejoin:
+        if args.reshape:
+            print("error: --step-rejoin and --reshape are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        return _run_step_rejoin(args, plan, workdir)
 
     reshape = _parse_reshape(args.reshape)
     # Reshape runs flip the demo into explicit multi-device mode: a

@@ -356,9 +356,25 @@ def maybe_injector_from_env(*, steps_per_epoch: int,
     if rank is None:
         import jax
 
-        rank = jax.process_index()
+        env_rank = os.environ.get("TPU_DIST_REJOIN_RANK")
+        if env_rank is not None and jax.process_count() == 1:
+            # Supervised single-process workers all see process_index() == 0;
+            # their true gang rank flows through the environment (the same
+            # convention the rejoin gates use), so a `:rankN` fault coordinate
+            # can actually target rank N.
+            rank = int(env_rank)
+        else:
+            rank = jax.process_index()
     if attempt is None:
         attempt = events.current_attempt()
+        # A worker relaunched INTO a live attempt (per-rank rejoin / gang
+        # reform) inherits the attempt number — folding its incarnation in
+        # keeps attempt-0 one-shot faults from re-firing forever in every
+        # replacement.
+        try:
+            attempt += int(os.environ.get("TPU_DIST_GANG_REJOIN", "0") or 0)
+        except ValueError:
+            pass
     mine = plan.for_process(rank, attempt)
     # Job-domain filter: faults carrying a @jobN coordinate arm only in
     # the worker gang whose $TPU_DIST_JOB_INDEX matches — the same plan is

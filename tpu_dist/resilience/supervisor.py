@@ -102,6 +102,13 @@ class AttemptOutcome:
     #: Longest SIGTERM→drained duration any rank of this attempt reported
     #: (from ``preempt_drained`` events); None when nothing drained.
     drain_s: Optional[float] = None
+    #: Mid-epoch gang reforms absorbed within this attempt (step-rejoin
+    #: mode: survivors kept their processes; only the clique re-formed).
+    gang_reforms: int = 0
+    #: ``time.monotonic()`` when this attempt's first worker death was
+    #: DETECTED — the honest zero point for recovery_wall_s, measured the
+    #: same way whether recovery is a gang restart or a mid-epoch rejoin.
+    first_failure_t: Optional[float] = None
 
     @property
     def succeeded(self) -> bool:
@@ -143,6 +150,7 @@ class SupervisorReport:
             "rejoins": [o.rejoins for o in self.outcomes],
             "drain_s": [None if o.drain_s is None else round(o.drain_s, 3)
                         for o in self.outcomes],
+            "gang_reforms": [o.gang_reforms for o in self.outcomes],
         }
 
 
@@ -195,7 +203,10 @@ class Supervisor:
                  device_schedule: Optional[Sequence[int]] = None,
                  rejoin_window_s: float = 0.0,
                  max_rejoins: int = 4,
-                 no_restart_exits: Sequence[int] = (EXIT_INTEGRITY,)):
+                 no_restart_exits: Sequence[int] = (EXIT_INTEGRITY,),
+                 step_rejoin_dir: Optional[str | os.PathLike] = None,
+                 reform_ack_timeout_s: float = 60.0,
+                 rank_scoped_env_keys: Sequence[str] = ()):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
         if max_restarts < 0:
@@ -240,6 +251,24 @@ class Supervisor:
         #: overrides this: ``serve_abort`` (a wedged decode runtime) IS
         #: cured by a fresh process.
         self.no_restart_exits = frozenset(int(c) for c in no_restart_exits)
+        #: Mid-epoch gang reform (step-rejoin mode): a shared directory for
+        #: the gang-generation protocol. When set, a lost rank triggers a
+        #: REFORM — survivors drain at the next step boundary, ack, and the
+        #: replacement meets them at a generation rendezvous — instead of a
+        #: gang restart. Rejoin eligibility is implied (no separate window).
+        self.step_rejoin_dir = (pathlib.Path(step_rejoin_dir)
+                                if step_rejoin_dir is not None else None)
+        #: How long survivors get to drain + ack a reform before the
+        #: supervisor gives up and condemns the attempt (gang restart).
+        self.reform_ack_timeout_s = float(reform_ack_timeout_s)
+        #: Env var names whose values get a ``/rank{r}`` suffix per worker —
+        #: e.g. the checkpoint dir, so two single-process workers that each
+        #: believe they are the chief don't race the same staging files.
+        self.rank_scoped_env_keys = tuple(rank_scoped_env_keys)
+        #: Current committed gang generation (bumped by each reform).
+        self._generation = 0
+        #: Consensus restore step of the latest reform (for replacements).
+        self._restore_step: Optional[int] = None
 
     # -- elastic gang shapes -------------------------------------------------
 
@@ -257,7 +286,7 @@ class Supervisor:
 
     # -- launching -----------------------------------------------------------
 
-    def _worker_env(self, rank: int, attempt: int) -> dict:
+    def _worker_env(self, rank: int, attempt: int, rejoin: int = 0) -> dict:
         env = dict(os.environ)
         env.update(self.env)
         env[events.ATTEMPT_ENV] = str(attempt)
@@ -272,7 +301,7 @@ class Supervisor:
             # Fresh ports every attempt: rank 0 hosted the coordination
             # service and took it down with itself; the old port may also
             # sit in TIME_WAIT.
-            if rank == 0:
+            if rank == 0 and rejoin == 0:
                 self._base_port = _free_port()
             cfg = make_local_cluster(workers, base_port=self._base_port)[rank]
             env.update({
@@ -280,6 +309,11 @@ class Supervisor:
                 "JAX_PLATFORMS": "cpu",
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
                 "PALLAS_AXON_POOL_IPS": "",
+                # Gang coordinates for the file-based rendezvous layers:
+                # each supervised worker is its own jax process (process
+                # index 0), so its true rank must flow via the environment.
+                "TPU_DIST_REJOIN_RANK": str(rank),
+                "TPU_DIST_REJOIN_WORLD": str(workers),
             })
         devices = self.device_count(attempt)
         if devices is not None:
@@ -288,6 +322,26 @@ class Supervisor:
                 "XLA_FLAGS":
                     f"--xla_force_host_platform_device_count={devices}",
             })
+        if self.step_rejoin_dir is not None:
+            from tpu_dist.cluster import bootstrap
+
+            env[bootstrap.GANG_DIR_ENV] = str(self.step_rejoin_dir)
+            env[bootstrap.GENERATION_ENV] = str(self._generation)
+        if rejoin:
+            # Incarnation counter for the relaunched process: attempt-0
+            # fault specs must not re-fire in the replacement (it would
+            # die again forever), so the injector folds this into its
+            # effective attempt number.
+            env["TPU_DIST_GANG_REJOIN"] = str(rejoin)
+            if self.step_rejoin_dir is not None:
+                # The replacement restores the reform's CONSENSUS step, not
+                # its dead predecessor's latest ("none" = from scratch).
+                step = getattr(self, "_restore_step", None)
+                env["TPU_DIST_RESTORE_STEP"] = (
+                    "none" if step is None else str(step))
+        for key in self.rank_scoped_env_keys:
+            if key in env and env[key]:
+                env[key] = str(pathlib.Path(env[key]) / f"rank{rank}")
         return env
 
     def worker_log(self, attempt: int, rank: int,
@@ -302,7 +356,7 @@ class Supervisor:
         # its own descriptor.
         with open(log_path, "wb") as log:
             return subprocess.Popen(
-                self.cmd, env=self._worker_env(rank, attempt),
+                self.cmd, env=self._worker_env(rank, attempt, rejoin),
                 stdout=log, stderr=subprocess.STDOUT)
 
     def _launch(self, attempt: int) -> list:
@@ -329,10 +383,14 @@ class Supervisor:
         """Per-rank relaunch eligibility: rejoin mode armed, budget left,
         the rest of the gang still running, and not the chief — rank 0
         hosts the coordination service, so its death takes the clique's
-        rendezvous medium with it and only a gang restart recovers."""
-        return (self.rejoin_window_s > 0
+        rendezvous medium with it and only a gang restart recovers. In
+        step-rejoin (gang reform) mode the chief restriction lifts: the
+        reformed clique gets a FRESH coordinator port, so a relaunched
+        rank 0 can host it."""
+        return ((self.rejoin_window_s > 0
+                 or self.step_rejoin_dir is not None)
                 and rejoins < self.max_rejoins
-                and rank != 0
+                and (rank != 0 or self.step_rejoin_dir is not None)
                 and live_others
                 and code != 0)
 
@@ -350,9 +408,19 @@ class Supervisor:
         failed = False
         deadline_hit = False
         rejoins = 0
+        gang_reforms = 0
+        first_failure_t: Optional[float] = None
+        # Per-rank last-seen-alive time: detect_s = detection minus this,
+        # the vehicle-level analog of the heartbeat-timeout window that
+        # dominates detection latency on a real backend.
+        last_alive = {rank: t0 for rank in range(len(procs))}
         reported: set = set()
         while True:
             live = [p for p in procs if p.poll() is None]
+            now = time.monotonic()
+            for rank, p in enumerate(procs):
+                if p.poll() is None:
+                    last_alive[rank] = now
             for rank, p in enumerate(procs):
                 code = p.poll()
                 if code is not None and (rank, p.pid) not in reported:
@@ -363,9 +431,18 @@ class Supervisor:
                                 rank, code, classify_exit(code))
                     if code == 0:
                         continue
+                    if first_failure_t is None:
+                        first_failure_t = time.monotonic()
                     others_live = any(q.poll() is None for q in procs
                                       if q is not p)
                     if self._can_rejoin(rank, code, rejoins, others_live):
+                        detect_s = time.monotonic() - last_alive[rank]
+                        if self.step_rejoin_dir is not None:
+                            if not self._begin_reform(procs, rank, attempt,
+                                                      detect_s):
+                                failed = True
+                                continue
+                            gang_reforms += 1
                         rejoins += 1
                         procs[rank] = self._spawn(rank, attempt,
                                                   rejoin=rejoins)
@@ -430,7 +507,102 @@ class Supervisor:
                               deadline_hit=deadline_hit,
                               num_workers=self.gang_size(attempt),
                               device_count=self.device_count(attempt),
-                              rejoins=rejoins)
+                              rejoins=rejoins, gang_reforms=gang_reforms,
+                              first_failure_t=first_failure_t)
+
+    def _begin_reform(self, procs: list, lost_rank: int, attempt: int,
+                      detect_s: float) -> bool:
+        """Supervisor side of a mid-epoch gang reform.
+
+        Publishes the reform request for generation g+1, waits for every
+        survivor's drained-ack, computes the consensus restore step (the
+        gang-wide minimum over the survivors' available checkpoints and the
+        lost rank's directory), commits it plus the new generation, and
+        returns True — the caller then spawns the replacement, which meets
+        the survivors at the generation rendezvous. Returns False (condemn
+        the attempt to a gang restart) if a survivor dies mid-reform or the
+        acks don't arrive within ``reform_ack_timeout_s``.
+        """
+        from tpu_dist.cluster import bootstrap
+
+        new_gen = self._generation + 1
+        bootstrap.request_reform(self.step_rejoin_dir, generation=new_gen,
+                                 lost_ranks=[lost_rank], detect_s=detect_s)
+        survivors = [r for r, p in enumerate(procs)
+                     if r != lost_rank and p.poll() is None]
+        t0 = time.monotonic()
+        ack_deadline = t0 + self.reform_ack_timeout_s
+        while True:
+            acks = bootstrap.read_reform_acks(self.step_rejoin_dir,
+                                              generation=new_gen)
+            if set(survivors) <= set(acks):
+                break
+            dead = [r for r in survivors if procs[r].poll() is not None]
+            if dead:
+                self._log("gang_reform_failed", attempt=attempt,
+                          generation=new_gen, reason="survivor_died",
+                          ranks=dead)
+                logger.warning("supervisor: survivor rank(s) %s died "
+                               "mid-reform; falling back to gang restart",
+                               dead)
+                return False
+            if time.monotonic() > ack_deadline:
+                self._log("gang_reform_failed", attempt=attempt,
+                          generation=new_gen, reason="ack_timeout",
+                          acked=sorted(acks), survivors=survivors)
+                logger.warning(
+                    "supervisor: reform acks %s/%s within %.1fs; falling "
+                    "back to gang restart", sorted(acks), survivors,
+                    self.reform_ack_timeout_s)
+                return False
+            time.sleep(_POLL_S)
+        ack_wait_s = time.monotonic() - t0
+
+        # Consensus restore step: minimum over every gang member's durable
+        # checkpoints — survivors report theirs in the ack; the lost rank's
+        # directory is read here (it can be BEHIND the survivors: its async
+        # save may never have published before the kill). Any member with
+        # no checkpoint at all forces a from-scratch replay for everyone
+        # (epoch-keyed RNG keeps that exact).
+        steps = [acks[r].get("available_step") for r in survivors]
+        if self.rank_scoped_env_keys:
+            # Per-rank checkpoint dirs: the replacement restores from the
+            # lost rank's directory, so its contents bound the consensus
+            # too. (With a shared directory the survivors' acks already
+            # describe exactly what the replacement will see.)
+            steps.append(self._lost_rank_step(lost_rank))
+        consensus = None if any(s is None for s in steps) else min(steps)
+        bootstrap.publish_restore_step(self.step_rejoin_dir,
+                                       generation=new_gen, step=consensus)
+        self._restore_step = consensus
+        self._generation = new_gen
+        bootstrap.publish_generation(self.step_rejoin_dir, new_gen)
+        self._log("gang_reform_requested", attempt=attempt,
+                  generation=new_gen, lost_ranks=[lost_rank],
+                  detect_s=round(detect_s, 6),
+                  ack_wait_s=round(ack_wait_s, 6),
+                  restore_step=consensus)
+        logger.info(
+            "supervisor: gang reform to generation %d (lost rank %d, "
+            "restore step %s, acks in %.3fs)", new_gen, lost_rank,
+            consensus, ack_wait_s)
+        return True
+
+    def _lost_rank_step(self, lost_rank: int) -> Optional[int]:
+        """Newest complete checkpoint step in the lost rank's (rank-scoped)
+        checkpoint directory, or None when unknown/absent."""
+        for key in self.rank_scoped_env_keys:
+            base = self.env.get(key) or os.environ.get(key)
+            if not base:
+                continue
+            from tpu_dist.training import checkpoint as ckpt_lib
+
+            try:
+                return ckpt_lib.latest_complete_step(
+                    pathlib.Path(base) / f"rank{lost_rank}")
+            except OSError:
+                return None
+        return None
 
     def _attempt_drain_s(self, attempt: int) -> Optional[float]:
         """Longest drain any rank of ``attempt`` reported, from the shared
@@ -458,10 +630,16 @@ class Supervisor:
             outcome = self._watch(self._launch(attempt), attempt)
             outcome.drain_s = self._attempt_drain_s(attempt)
             outcomes.append(outcome)
+            # Recovery is measured from DETECTION of the first death — the
+            # same zero point whether recovery was a gang restart or a
+            # mid-epoch rejoin absorbed inside a succeeding attempt.
+            if t_first_failure is None:
+                t_first_failure = outcome.first_failure_t
             if outcome.succeeded:
-                if attempt > 0:
+                if attempt > 0 or outcome.rejoins:
                     self._log("recovered", attempt=attempt,
-                              restarts=attempt)
+                              restarts=attempt, rejoins=outcome.rejoins,
+                              gang_reforms=outcome.gang_reforms)
                 break
             if t_first_failure is None:
                 t_first_failure = time.monotonic()

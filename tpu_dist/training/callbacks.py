@@ -232,6 +232,23 @@ class ModelCheckpoint(Callback):
         except OSError as exc:
             self._write_failed(getattr(exc, "checkpoint_step", epoch), exc)
 
+    def publish_in_flight(self) -> None:
+        """Drain the async writer NOW without closing it.
+
+        The gang-reform drain point: before a survivor acks a reform it must
+        make its latest epoch checkpoint durable, or the relaunched rank
+        could restore one epoch behind the survivors and the rendezvous
+        coordinates would disagree. A write failure is absorbed like any
+        other (one lost interval), and the reform falls back to the previous
+        complete checkpoint on every rank alike.
+        """
+        if self._ckpt is None:
+            return
+        try:
+            self._ckpt.wait()
+        except OSError as exc:
+            self._write_failed(getattr(exc, "checkpoint_step", None), exc)
+
     def on_train_end(self):
         if self._ckpt is None:
             return

@@ -771,6 +771,17 @@ class Trainer:
         rejoin = maybe_rejoin_gate()
         if rejoin is not None:
             callbacks.append(rejoin)
+        # Mid-epoch gang reform: $TPU_DIST_GANG_DIR (set by the Supervisor
+        # in step-rejoin mode) arms the step-boundary reform gate — on a
+        # detected peer loss survivors drain here, reform the collective
+        # clique under a fresh generation, and meet the relaunched rank at
+        # a step-granular rendezvous instead of paying a gang restart.
+        from tpu_dist.resilience import rejoin as rejoin_lib
+
+        gang_gate = rejoin_lib.maybe_step_rejoin_gate(
+            steps_per_epoch=steps_per_epoch)
+        if gang_gate is not None:
+            callbacks.append(gang_gate)
         # Same env-armed pattern for telemetry (tpu_dist.observe): an
         # observe dir in $TPU_DIST_OBSERVE_DIR — set by the Supervisor for
         # chaos workers, or by a shell — attaches the Telemetry callback.
@@ -788,16 +799,31 @@ class Trainer:
             from tpu_dist.training import checkpoint as ckpt_lib
             from tpu_dist.training.callbacks import ModelCheckpoint
 
-            try:
-                restored = ckpt_lib.restore_model(checkpoint_dir, self.model,
-                                                  trainer=self)
-                initial_epoch = max(initial_epoch, restored + 1)
-                logger.info("resumed from checkpoint step %d; starting at "
-                            "epoch %d", restored, initial_epoch)
-                from tpu_dist.resilience import events
+            import os as _os
 
-                events.maybe_log("checkpoint_resume", step=restored,
-                                 initial_epoch=initial_epoch)
+            # A worker relaunched into a reformed gang restores the
+            # CONSENSUS step the supervisor stamped ("none" = scratch),
+            # not its own directory's latest — its dead predecessor's dir
+            # may be ahead of or behind the survivors'.
+            forced = _os.environ.get("TPU_DIST_RESTORE_STEP")
+            try:
+                if forced is None or forced == "":
+                    restored = ckpt_lib.restore_model(
+                        checkpoint_dir, self.model, trainer=self)
+                elif forced == "none":
+                    restored = None
+                else:
+                    restored = ckpt_lib.restore_model(
+                        checkpoint_dir, self.model, step=int(forced),
+                        trainer=self)
+                if restored is not None:
+                    initial_epoch = max(initial_epoch, restored + 1)
+                    logger.info("resumed from checkpoint step %d; starting "
+                                "at epoch %d", restored, initial_epoch)
+                    from tpu_dist.resilience import events
+
+                    events.maybe_log("checkpoint_resume", step=restored,
+                                     initial_epoch=initial_epoch)
             except FileNotFoundError:
                 pass
             # Don't double up save+barrier work if the caller already passed
@@ -865,6 +891,16 @@ class Trainer:
                         # converging.
                         start_epoch = self._integrity_rollback(
                             rb, guard, checkpoint_dir, seed)
+                    except rejoin_lib.GangReform as gr:
+                        # A peer died mid-epoch: run the survivor side of
+                        # the reform protocol (publish in-flight checkpoint,
+                        # ack, re-init the clique at generation g+1, restore,
+                        # meet the relaunched rank) and re-enter the loop —
+                        # same rollback-and-replay RNG discipline, so losses
+                        # stay exact.
+                        start_epoch = self._gang_reform(
+                            gr, gang_gate, cbs, checkpoint_dir, seed,
+                            steps_per_epoch)
         except StopTraining as e:
             logger.info("training stopped early: %s", e)
         finally:
@@ -917,6 +953,99 @@ class Trainer:
             "integrity rollback: anomaly %r at global step %d; restored "
             "checkpoint step %s, replaying from epoch %d",
             rb.kind, rb.gstep, restored, next_epoch)
+        return next_epoch
+
+    def _gang_reform(self, gr, gate, cbs, checkpoint_dir, seed,
+                     steps_per_epoch) -> int:
+        """Survivor side of a mid-epoch gang reform.
+
+        Phase order matters: (1) quiesce the input pipeline; (2) make the
+        latest epoch checkpoint durable and ACK — the supervisor relaunches
+        the lost rank only after every survivor has acked, so the rejoiner's
+        restore is guaranteed to see the published state; (3) re-initialize
+        the collective clique under the new generation; (4) restore the last
+        complete checkpoint (every rank converges on the same step, hence
+        the same rendezvous coordinate); (5) meet the reformed gang at the
+        step-granular barrier. Each phase's wall time is recorded — the
+        recovery breakdown the chaos report prints.
+        """
+        import time as _time
+
+        from tpu_dist.cluster import bootstrap as bootstrap_lib
+        from tpu_dist.observe import metrics as metrics_lib
+        from tpu_dist.resilience import events
+        from tpu_dist.training import checkpoint as ckpt_lib
+        from tpu_dist.training.callbacks import ModelCheckpoint
+
+        # -- drain: quiesce + publish in-flight checkpoints ----------------
+        self._iterator = None
+        self._close_prefetcher()
+        for cb in cbs.callbacks:
+            if isinstance(cb, ModelCheckpoint):
+                cb.publish_in_flight()
+        available = (ckpt_lib.latest_complete_step(checkpoint_dir)
+                     if checkpoint_dir is not None else None)
+        drain_s = _time.monotonic() - gr.seen_at
+        bootstrap_lib.ack_reform(gate.directory, generation=gr.generation,
+                                 rank=gate.rank, available_step=available)
+
+        # -- reform: new clique under generation g+1 -----------------------
+        t_reform = _time.monotonic()
+        bootstrap_lib.reinitialize(generation=gr.generation)
+        gate.generation = gr.generation
+
+        # -- restore: converge every rank on the CONSENSUS step ------------
+        # Per-rank checkpoint dirs can disagree by an epoch or two (ranks
+        # are only loosely coupled between barriers; the dead rank's async
+        # save may never have published). Restoring each rank's own latest
+        # would put the gang at different epochs and deadlock the reformed
+        # rendezvous — so the supervisor collects every ack's available
+        # step, takes the gang-wide minimum, and publishes it for all.
+        t_restore = _time.monotonic()
+        deadline = _time.monotonic() + gate.timeout_s
+        while True:
+            published, step = bootstrap_lib.read_restore_step(
+                gate.directory, generation=gr.generation)
+            if published:
+                break
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"gang reform: no consensus restore step for generation "
+                    f"{gr.generation} within {gate.timeout_s:.1f}s")
+            _time.sleep(0.05)
+        restored = None
+        if step is not None and checkpoint_dir is not None:
+            restored = ckpt_lib.restore_model(checkpoint_dir, self.model,
+                                              step=step, trainer=self)
+        if restored is None:
+            self.variables = None
+            self.ensure_variables(seed)
+            next_epoch = 0
+        else:
+            next_epoch = restored + 1
+        restore_s = _time.monotonic() - t_restore
+
+        # -- rendezvous: meet the relaunched rank mid-run ------------------
+        gate.rendezvous(step=next_epoch * steps_per_epoch, epoch=next_epoch)
+        reform_s = _time.monotonic() - t_reform
+
+        metrics_lib.inc("elastic.gang_reforms")
+        metrics_lib.observe_value("elastic.drain_s", drain_s)
+        metrics_lib.observe_value("elastic.reform_s", reform_s)
+        metrics_lib.observe_value("elastic.restore_s", restore_s)
+        events.maybe_log(
+            "gang_reform", generation=gr.generation,
+            lost_ranks=gr.lost_ranks, rank=gate.rank,
+            detect_s=gr.request.get("detect_s"),
+            drain_s=round(drain_s, 6), reform_s=round(reform_s, 6),
+            restore_s=round(restore_s, 6), restored_step=restored,
+            next_epoch=next_epoch, attempt=events.current_attempt())
+        logger.warning(
+            "gang reform: lost rank(s) %s; reformed at generation %d, "
+            "restored checkpoint step %s, replaying from epoch %d "
+            "(drain %.3fs reform %.3fs restore %.3fs)",
+            gr.lost_ranks, gr.generation, restored, next_epoch,
+            drain_s, reform_s, restore_s)
         return next_epoch
 
     def _run_epochs(self, dist, cbs, initial_epoch, epochs, steps_per_epoch,
