@@ -203,6 +203,20 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu TPU_DIST_DEMO_STEPS_PER_EPOCH=24 \
        exit 1; }
 rm -rf "$rejoin_dir"
 
+echo "== multichip-chaos-smoke: TP bitflip on the 8-device harness =="
+# The shard-aware SDC acceptance demo: a real fit on a {data: 4, model: 2}
+# mesh with one mantissa bit flipped in device 5's shard of the
+# column-parallel kernel. Gates inside the test: the audit names the
+# culprit leaf + shard-group + device + replica from checksums alone, the
+# rollback restores the pre-fault epoch checkpoint, the replayed losses
+# match the clean run EXACTLY (delta 0.0), and zero supervisor restarts —
+# recovery is entirely in-process.
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
+  tests/test_multichip_chaos.py -q -k bitflip_under_tp \
+  -p no:cacheprovider >/dev/null \
+  || { echo "check.sh: multichip chaos smoke failed" >&2
+       exit 1; }
+
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \

@@ -28,6 +28,22 @@ REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
 
 _RESULT_PREFIX = "HARNESS_RESULT:"
 
+
+class HarnessFailure(AssertionError):
+    """A NAMED harness-child failure.
+
+    ``mode`` says WHICH way the child failed — ``"timeout"``,
+    ``"nonzero_exit"``, ``"torn_result"`` (a HARNESS_RESULT line that is not
+    valid JSON, e.g. the child died mid-print), or ``"no_result"`` — so a
+    debugging human (or a test of the harness itself) doesn't have to parse
+    the message text. Subclasses AssertionError so existing callers that
+    catch/expect assertion failures keep working.
+    """
+
+    def __init__(self, mode: str, message: str):
+        self.mode = mode
+        super().__init__(message)
+
 #: Prepended to every snippet: pin the platform BEFORE jax initializes and
 #: give the body ``emit`` + the forced device-count sanity check.
 PRELUDE = """\
@@ -49,15 +65,40 @@ assert jax.device_count() == _want, (
 
 """
 
+#: The prelude for bodies that must run ``jax.distributed.initialize``
+#: themselves: touching ``jax.device_count()`` here would initialize the
+#: backend and make a later distributed bring-up illegal, so the device
+#: count is only handed over via ``_want`` and the body owns the check.
+DEFERRED_PRELUDE = """\
+import json, os, sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def emit(obj):
+    print("HARNESS_RESULT:" + json.dumps(obj), flush=True)
+
+
+_want = int(os.environ["TPU_DIST_HARNESS_DEVICES"])
+
+"""
+
 
 def run_with_devices(body: str, n_devices: int, *, timeout: float = 300.0,
-                     extra_env: dict | None = None) -> dict:
+                     extra_env: dict | None = None,
+                     init_backend: bool = True) -> dict:
     """Run ``PRELUDE + body`` in a subprocess with ``n_devices`` virtual CPU
     devices; returns the dict the body passed to ``emit``.
 
-    Raises AssertionError (with captured output) if the subprocess fails,
-    times out, or emits no result — a harness problem must read as a test
-    failure, never a silent pass.
+    Raises :class:`HarnessFailure` (an AssertionError carrying a named
+    ``mode`` plus the captured output) if the subprocess times out, exits
+    nonzero, emits a torn ``HARNESS_RESULT`` line, or emits none — a
+    harness problem must read as a test failure, never a silent pass.
+
+    ``init_backend=False`` swaps in :data:`DEFERRED_PRELUDE` for bodies
+    that must bring up ``jax.distributed`` before the first computation.
     """
     env = dict(os.environ)
     env.update({
@@ -68,25 +109,38 @@ def run_with_devices(body: str, n_devices: int, *, timeout: float = 300.0,
         "PYTHONPATH": REPO_ROOT + os.pathsep + env.get("PYTHONPATH", ""),
     })
     env.update(extra_env or {})
+    prelude = PRELUDE if init_backend else DEFERRED_PRELUDE
     proc = subprocess.Popen(
-        [sys.executable, "-c", PRELUDE + body],
+        [sys.executable, "-c", prelude + body],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         proc.kill()
         out, err = proc.communicate()
-        raise AssertionError(
+        raise HarnessFailure(
+            "timeout",
             f"{n_devices}-device harness run timed out after {timeout}s\n"
             f"--- stdout ---\n{out}\n--- stderr ---\n{err}")
-    assert proc.returncode == 0, (
-        f"{n_devices}-device harness run exited {proc.returncode}\n"
-        f"--- stdout ---\n{out}\n--- stderr ---\n{err}")
+    if proc.returncode != 0:
+        raise HarnessFailure(
+            "nonzero_exit",
+            f"{n_devices}-device harness run exited {proc.returncode}\n"
+            f"--- stdout ---\n{out}\n--- stderr ---\n{err}")
     result = None
     for line in out.splitlines():
         if line.startswith(_RESULT_PREFIX):
-            result = json.loads(line[len(_RESULT_PREFIX):])
-    assert result is not None, (
-        f"{n_devices}-device harness run emitted no {_RESULT_PREFIX} line\n"
-        f"--- stdout ---\n{out}\n--- stderr ---\n{err}")
+            try:
+                result = json.loads(line[len(_RESULT_PREFIX):])
+            except ValueError:
+                raise HarnessFailure(
+                    "torn_result",
+                    f"{n_devices}-device harness run emitted a torn "
+                    f"{_RESULT_PREFIX} line (not valid JSON): {line!r}\n"
+                    f"--- stdout ---\n{out}\n--- stderr ---\n{err}")
+    if result is None:
+        raise HarnessFailure(
+            "no_result",
+            f"{n_devices}-device harness run emitted no {_RESULT_PREFIX} "
+            f"line\n--- stdout ---\n{out}\n--- stderr ---\n{err}")
     return result

@@ -390,6 +390,49 @@ class TestSupervisorGangReform:
                             "gang_reform_failed")
         assert ev["reason"] == "ack_timeout"
 
+    def test_second_loss_mid_reform_falls_back_to_gang_restart(
+            self, tmp_path):
+        """Reform-during-reform: a SECOND rank dies while survivors drain.
+        The attempt is condemned with ``cause=second_loss``, the stale
+        reform request is withdrawn (a restarted gang reading it would
+        re-enter a reform nobody mediates), and the ordinary gang restart
+        completes the run cleanly."""
+        marker_dir = tmp_path / "markers"
+        marker_dir.mkdir()
+        cmd = [sys.executable, "-c", textwrap.dedent(f"""\
+            import os, pathlib, sys, time
+
+            rank = int(os.environ["TPU_DIST_REJOIN_RANK"])
+            marker = pathlib.Path({str(marker_dir)!r}) / f"died-{{rank}}"
+            if marker.exists():
+                sys.exit(0)  # the restarted gang runs clean
+            marker.write_text("x")
+            if rank == 1:
+                time.sleep(0.2)
+                sys.exit(7)   # first loss: triggers the reform
+            time.sleep(1.5)   # second loss: dies mid-drain, never acks
+            sys.exit(5)
+        """)]
+        gang = tmp_path / "gang"
+        sup = Supervisor(
+            cmd, num_workers=2, max_restarts=1,
+            step_rejoin_dir=gang, reform_ack_timeout_s=30.0,
+            grace=GracePolicy(exit_grace_s=0.3, term_grace_s=5.0),
+            log_dir=tmp_path / "logs",
+            event_log=EventLog(tmp_path / "events.jsonl",
+                               role="supervisor"))
+        report = sup.run()
+        assert report.success, report.to_json()
+        assert report.restarts == 1  # the fallback gang restart
+        assert report.outcomes[0].gang_reforms == 0
+        (ev,) = read_events(tmp_path / "events.jsonl",
+                            "gang_reform_failed")
+        assert ev["reason"] == "survivor_died"
+        assert ev["cause"] == "second_loss"
+        assert ev["ranks"] == [0]
+        # The stale g+1 request must not outlive the condemned attempt.
+        assert bootstrap.read_reform_request(gang) is None
+
 
 class TestInjectorGangIdentity:
     def test_rank_env_override_targets_rankN_faults(self, monkeypatch):

@@ -76,6 +76,21 @@ class TestFaultGrammar:
         assert plan.faults[2].rank == 3
         assert FaultPlan.parse(plan.dumps()) == plan  # JSON roundtrip
 
+    def test_bitflip_leaf_and_replica_addressing(self):
+        plan = FaultPlan.parse("bitflip@step9:leaf2:replica5")
+        (f,) = plan.faults
+        assert f.kind == "bitflip" and f.step == 9
+        assert f.leaf == 2 and f.replica == 5
+        assert f.rank == 0  # replica does not alias rank
+        assert FaultPlan.parse(plan.dumps()) == plan  # JSON roundtrip
+        # Plans without the new modifiers keep leaf/replica unset (so
+        # to_json drops them and old plans roundtrip unchanged).
+        (g,) = FaultPlan.parse("bitflip@step9:rank3").faults
+        assert g.leaf is None and g.replica is None
+        # The addressing is bitflip-only.
+        with pytest.raises(ValueError, match="bitflip"):
+            FaultPlan.parse("nan_loss@step5:leaf1")
+
     def test_bitflip_rank_armed_in_single_process(self, monkeypatch):
         from tpu_dist.resilience.injector import maybe_injector_from_env
 
@@ -265,15 +280,116 @@ class TestBatchSeam:
 
 
 class TestSDCAudit:
-    def test_audit_skipped_on_model_parallel_mesh(self):
-        class FakeStrategy:
-            model_parallel = True
-            pipeline_parallel = False
-            expert_parallel = False
+    def test_shard_groups_tp_kernel_and_replicated_bias(self, eight_devices):
+        """On a {data:4, model:2} mesh, a column-sharded kernel has one
+        shard group per column block (each replicated across the data
+        axis); a replicated bias has one global group."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-        guard = IntegrityGuard(IntegrityConfig(audit_every_n=1))
-        guard.bind(FakeStrategy())
-        assert guard.audit({"w": np.ones(3)}, gstep=4) is True  # no-op skip
+        from tpu_dist.parallel.mesh import shard_groups
+
+        strategy = td.MirroredStrategy(axis_shapes={"data": 4, "model": 2})
+        kernel = jax.device_put(
+            np.zeros((4, 8), np.float32),
+            NamedSharding(strategy.mesh, P(None, "model")))
+        bias = jax.device_put(np.zeros(8, np.float32),
+                              NamedSharding(strategy.mesh, P()))
+        assert shard_groups(kernel.sharding, kernel.shape) == [
+            [0, 2, 4, 6], [1, 3, 5, 7]]
+        assert shard_groups(bias.sharding, bias.shape) == [
+            [0, 1, 2, 3, 4, 5, 6, 7]]
+
+    def test_audit_runs_on_model_parallel_mesh(self, eight_devices):
+        """The replicated-only skip is GONE: on a TP mesh the audit
+        checksums each device's shard and compares within shard groups —
+        a flip into one shard of a sharded leaf names the culprit leaf,
+        shard-group, device and replica."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        strategy = td.MirroredStrategy(axis_shapes={"data": 4, "model": 2})
+        mesh = strategy.mesh
+        params = {
+            "dense": {
+                "bias": jax.device_put(np.ones(8, np.float32),
+                                       NamedSharding(mesh, P())),
+                "kernel": jax.device_put(
+                    np.arange(32, dtype=np.float32).reshape(4, 8) / 32.0,
+                    NamedSharding(mesh, P(None, "model"))),
+            },
+        }
+        guard = IntegrityGuard(IntegrityConfig(audit_every_n=2))
+        guard.bind(strategy)
+        assert guard.audit(params, gstep=2) is True  # clean shards agree
+
+        v = {"params": params}
+        info = integrity.flip_param_bit(v, replica=5, leaf=1)
+        assert info["leaf_index"] == 1
+        assert info["effective_bit"] == 22  # f32: bit stays as asked
+        with pytest.raises(integrity.RollbackAndReplay) as ei:
+            guard.audit(v["params"], gstep=4)
+        (culprit,) = ei.value.detail["culprits"]
+        assert culprit["leaf"] == info["leaf"]
+        assert culprit["replica"] == 5
+        assert culprit["device"] == info["device"]
+        # Device 5 on a data-major [4, 2] mesh sits in model column 1.
+        assert culprit["shard_group"] == 1
+
+    def test_bf16_clean_run_no_false_positives(self, eight_devices):
+        """200 synthetic steps of noisy bf16 training (grad norms varying
+        ~3x step to step) with periodic audits over identical replicas:
+        ZERO anomalies — the low-precision slack widens the spike
+        threshold and the f32-upcast checksum sees no phantom drift."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        strategy = td.MirroredStrategy()
+        params = {
+            "w": jax.device_put(
+                np.linspace(-1, 1, 64).astype(jnp.bfloat16.dtype),
+                NamedSharding(strategy.mesh, P()))}
+        guard = IntegrityGuard(IntegrityConfig(
+            audit_every_n=50, spike_factor=8.0, bf16_spike_slack=4.0,
+            rollback_budget=0))  # any anomaly would raise immediately
+        guard.bind(strategy)
+        rng = np.random.default_rng(0)
+        for step in range(200):
+            gnorm = float(rng.uniform(0.5, 1.5) * 3.0 ** rng.integers(0, 2))
+            health = np.array([0.0, gnorm ** 2, 0.1], np.float32)
+            guard.on_execution(step, 1, health, params)
+        guard.flush()
+        assert guard._rollbacks == 0
+        assert guard._low_precision is True
+
+    def test_bf16_slack_widens_spike_threshold(self):
+        """The same 6x-over-EMA jump that spikes an f32 guard is tolerated
+        on low-precision params (slack 4 -> threshold 12x)."""
+        cfg = dict(spike_factor=3.0, warmup_steps=2, rollback_budget=0)
+        g32 = IntegrityGuard(IntegrityConfig(**cfg))
+        gbf = IntegrityGuard(IntegrityConfig(bf16_spike_slack=4.0, **cfg))
+        gbf._low_precision = True
+        for s in range(4):
+            h = np.array([0.0, 1.0, 0.0])
+            g32._judge(s, 1, h)
+            gbf._judge(s, 1, h)
+        spike = np.array([0.0, 36.0, 0.0])  # gnorm 6 vs EMA 1
+        with pytest.raises(IntegrityAbort):  # budget 0: anomaly -> abort
+            g32._judge(9, 1, spike)
+        gbf._judge(9, 1, spike)
+        assert gbf._rollbacks == 0
+
+    def test_loss_scale_judges_in_true_units(self):
+        """A static loss scale of 1024 must not read as a permanent spike:
+        the guard divides grad norms by the scale before the EMA compare."""
+        guard = IntegrityGuard(IntegrityConfig(
+            spike_factor=5.0, warmup_steps=2, loss_scale=1024.0,
+            rollback_budget=0))
+        for step in range(8):
+            scaled = (1.0 + 0.1 * step) * 1024.0  # raw norms are S x larger
+            guard._judge(step, 1, np.array([0.0, scaled ** 2, 0.0]))
+        assert guard._rollbacks == 0
 
     def test_bitflip_detected_and_restore_bit_identical(self, tmp_path):
         """8 virtual devices: flip one mantissa bit on ONE replica's copy

@@ -740,6 +740,40 @@ def _trace_integrity_audit_checksum():
         return jax.make_jaxpr(fn)(*leaves)
 
 
+def _trace_integrity_audit_checksum_sharded():
+    """The SHARD-AWARE audit program on a TP mesh (``{data: 4, model: 2}``):
+    sharded leaves are checksummed shard-locally (``in_specs`` taken from
+    the live ``NamedSharding``s — column-parallel kernel, sharded bias,
+    row-parallel kernel, replicated bias, the Megatron layout) and the
+    shard-group comparison happens on host. Pins that shard-awareness
+    added NO collective: the sharded table build is as comm-free as the
+    replicated one — exactly 0 baselined bytes."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_dist.parallel.strategy import MirroredStrategy
+    from tpu_dist.training.integrity import build_audit_checksum
+
+    if jax.device_count() < 8:
+        raise RuntimeError("needs >= 8 devices for a data x model mesh")
+    strategy = MirroredStrategy(axis_shapes={"data": 4, "model": 2})
+    mesh = strategy.mesh
+    leaves = [
+        jax.device_put(np.zeros(8, np.float32),
+                       NamedSharding(mesh, P("model"))),
+        jax.device_put(np.zeros((4, 8), np.float32),
+                       NamedSharding(mesh, P(None, "model"))),
+        jax.device_put(np.zeros(4, np.float32), NamedSharding(mesh, P())),
+        jax.device_put(np.zeros((8, 4), np.float32),
+                       NamedSharding(mesh, P("model", None))),
+    ]
+    specs = tuple(P(*l.sharding.spec) for l in leaves)
+    key = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+    fn = build_audit_checksum(mesh, key, specs)
+    return jax.make_jaxpr(fn)(*leaves)
+
+
 def _trace_jobs_runtime_train_step():
     """The trainer step built INSIDE a multi-tenant job scope
     (jobs/runtime.py): same probe model as ``training.trainer.train_step``
@@ -814,6 +848,8 @@ ENTRY_POINTS = {
     "serve.paged_decode_step": _trace_serve_paged_decode,
     "training.integrity.health_step": _trace_integrity_health_step,
     "training.integrity.audit_checksum": _trace_integrity_audit_checksum,
+    "training.integrity.audit_checksum_sharded":
+        _trace_integrity_audit_checksum_sharded,
     "jobs.runtime.train_step": _trace_jobs_runtime_train_step,
     "jobs.runtime.decode_step": _trace_jobs_runtime_decode_step,
 }

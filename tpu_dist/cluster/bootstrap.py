@@ -35,6 +35,13 @@ logger = logging.getLogger("tpu_dist")
 _STATE_LOCK = threading.Lock()
 _INITIALIZED = False
 _CONFIG: Optional[ClusterConfig] = None
+#: The explicit (coordinator_address, num_processes, process_id) the
+#: distributed client was last brought up with, recorded by ``_dist_init``.
+#: This is what lets ``reinitialize`` run a REAL teardown + re-init even
+#: when TF_CONFIG is absent — e.g. an explicit single-process bring-up
+#: against a coordination service, where ``jax.process_count() == 1`` but a
+#: live client exists. None when no explicit bring-up happened.
+_DIST_PARAMS: Optional[dict] = None
 #: Gang generation of this process's collective clique (see
 #: ``current_generation``); None until first read (env or reinitialize).
 _GENERATION: Optional[int] = None
@@ -49,13 +56,40 @@ def _dist_init(**kwargs):
     # jax < 0.5 has no heartbeat_timeout_seconds (or other newer)
     # kwargs on jax.distributed.initialize; drop what this version
     # doesn't accept rather than failing bring-up.
+    global _DIST_PARAMS
     import inspect
 
     import jax
 
+    allow_live_backend = kwargs.pop("allow_live_backend", False)
     sig = inspect.signature(jax.distributed.initialize)
-    jax.distributed.initialize(**{
-        k: v for k, v in kwargs.items() if k in sig.parameters})
+    try:
+        jax.distributed.initialize(**{
+            k: v for k, v in kwargs.items() if k in sig.parameters})
+    except RuntimeError as exc:
+        if (not allow_live_backend
+                or "before any JAX computations" not in str(exc)):
+            raise
+        # Mid-process RE-dial: a gang-reform survivor has been computing
+        # for epochs, so its backend is necessarily live, and the public
+        # API refuses re-init categorically. The coordination service
+        # (gRPC, C++ side) is independent of the local device backend, so
+        # bring the service + client up directly; only ``reinitialize``
+        # sets ``allow_live_backend`` — a FIRST bring-up after
+        # computations still fails loudly, since there the backend's
+        # process/device view really would be stale.
+        from jax._src import distributed as _dist
+
+        state_sig = inspect.signature(_dist.global_state.initialize)
+        _dist.global_state.initialize(**{
+            k: v for k, v in kwargs.items() if k in state_sig.parameters})
+        logger.info(
+            "tpu_dist: re-dialed coordination service at %s under a live "
+            "backend", kwargs.get("coordinator_address"))
+    if kwargs.get("coordinator_address") and kwargs.get("num_processes"):
+        _DIST_PARAMS = {k: kwargs.get(k) for k in
+                        ("coordinator_address", "num_processes",
+                         "process_id")}
 
 
 def initialize(config: ClusterConfig | None = None, *,
@@ -207,12 +241,16 @@ def reinitialize(generation: Optional[int] = None, *,
     endpoint without communicating — the old coordinator may have died with
     the lost rank, and its port may sit in TIME_WAIT.
 
-    In single-process mode (including the CI file-gang vehicle, where each
-    supervised worker is its own jax process and the gang exists only in the
-    shared-filesystem rendezvous) there is no clique to tear down: the call
-    just re-stamps the generation, which re-namespaces every subsequent
-    rendezvous marker. Returns the new generation (``generation`` when
-    given, else current + 1).
+    In single-process LOCAL mode (including the CI file-gang vehicle, where
+    each supervised worker is its own jax process and the gang exists only
+    in the shared-filesystem rendezvous) there is no clique to tear down:
+    the call just re-stamps the generation, which re-namespaces every
+    subsequent rendezvous marker. An EXPLICIT bring-up, however — even with
+    ``num_processes == 1`` — started a real distributed client against a
+    coordination service, so the real teardown + re-init path runs for it
+    too (this is how the multi-device harness proves the collectives-capable
+    leg on the CPU backend). Returns the new generation (``generation``
+    when given, else current + 1).
     """
     global _INITIALIZED, _GENERATION
     import jax
@@ -222,27 +260,32 @@ def reinitialize(generation: Optional[int] = None, *,
     with _STATE_LOCK:
         was_up = _INITIALIZED
         config = _CONFIG
-        multi = False
-        if was_up:
+        if config is not None and config.num_processes > 1:
+            params = {"coordinator_address": config.coordinator_address,
+                      "num_processes": config.num_processes,
+                      "process_id": config.process_id}
+        elif _DIST_PARAMS is not None:
+            params = dict(_DIST_PARAMS)
+        else:
+            params = None
+        if was_up and params is not None:
+            # A real distributed client is up (multi-process TF_CONFIG, or
+            # an explicit bring-up with a coordination service): release
+            # membership in the dead clique before re-dialing.
             try:
-                multi = jax.process_count() > 1
-            except RuntimeError:  # pragma: no cover - backend not ready
-                multi = False
-            if multi:
-                try:
-                    jax.distributed.shutdown()
-                except Exception as exc:  # the old clique is already broken
-                    logger.warning(
-                        "tpu_dist: shutdown of generation %d clique failed "
-                        "(%s); continuing with re-init", _GENERATION, exc)
+                jax.distributed.shutdown()
+            except Exception as exc:  # the old clique is already broken
+                logger.warning(
+                    "tpu_dist: shutdown of generation %d clique failed "
+                    "(%s); continuing with re-init", _GENERATION, exc)
             _INITIALIZED = False
         _GENERATION = new_gen
         # Re-exported so child processes (and a later current_generation()
         # after module reload) observe the reformed clique's id.
         os.environ[GENERATION_ENV] = str(new_gen)
 
-    if config is not None and config.num_processes > 1:
-        host, _, base_port = config.coordinator_address.rpartition(":")
+    if params is not None:
+        host, _, base_port = params["coordinator_address"].rpartition(":")
         try:
             port = (int(coordinator_port) if coordinator_port is not None
                     else int(base_port) + new_gen)
@@ -251,20 +294,21 @@ def reinitialize(generation: Optional[int] = None, *,
         hb = float(os.environ.get("TPU_DIST_HEARTBEAT_TIMEOUT_S", "100"))
         logger.info(
             "tpu_dist: reforming %d-process clique at generation %d "
-            "(coordinator %s:%s)", config.num_processes, new_gen, host, port)
+            "(coordinator %s:%s)", params["num_processes"], new_gen, host,
+            port)
         _dist_init(
             coordinator_address=f"{host}:{port}",
-            num_processes=config.num_processes,
-            process_id=config.process_id,
+            num_processes=params["num_processes"],
+            process_id=params["process_id"],
             heartbeat_timeout_seconds=max(1, round(hb)),
+            allow_live_backend=True,
         )
         _log_bringup()
     else:
         logger.info("tpu_dist: gang generation -> %d (single-process "
                     "clique; rendezvous namespace re-stamped)", new_gen)
     with _STATE_LOCK:
-        _INITIALIZED = was_up or (config is not None
-                                  and config.num_processes > 1)
+        _INITIALIZED = was_up or params is not None
     return new_gen
 
 
@@ -556,6 +600,24 @@ def read_reform_request(directory) -> Optional[dict]:
     if not isinstance(req, dict) or "generation" not in req:
         return None
     return req
+
+
+def withdraw_reform(directory) -> None:
+    """Remove a pending reform request (supervisor side).
+
+    Called when an in-flight reform is abandoned — a SECOND rank died while
+    survivors were draining, or the acks timed out — and the attempt falls
+    back to an ordinary gang restart. The request must not outlive the
+    attempt: a relaunched worker's rejoin gate reading a stale request for a
+    future generation would drain into a reform no supervisor is mediating.
+    Idempotent; missing file is fine.
+    """
+    import pathlib
+
+    try:
+        (pathlib.Path(directory) / "reform-request.json").unlink()
+    except OSError:
+        pass
 
 
 def ack_reform(directory, *, generation: int, rank: int,

@@ -137,6 +137,30 @@ def replicated(mesh):
     return NamedSharding(mesh, PartitionSpec())
 
 
+def shard_groups(sharding, shape):
+    """Device positions (rows of the owning mesh's flat device order)
+    grouped by IDENTICAL shard of a ``shape``-d array.
+
+    Devices in one group hold the same bytes under ``sharding`` — they are
+    replicas of that shard and must agree bit-for-bit in healthy training;
+    devices in different groups legitimately hold different data. A
+    replicated sharding yields one global group; a tensor-parallel kernel
+    yields one group per distinct shard (e.g. per column block). Groups are
+    ordered by their shard's index ranges, so a group id is stable for a
+    given (sharding, shape). This is the comparison structure the
+    shard-aware SDC audit (training/integrity.py) runs on host.
+    """
+    devices = list(sharding.mesh.devices.flat)
+    row_of = {d: i for i, d in enumerate(devices)}
+    by_shard: dict = {}
+    for d, idx in sharding.devices_indices_map(tuple(shape)).items():
+        if d not in row_of:  # pragma: no cover - defensive
+            continue
+        key = tuple(s.indices(dim) for s, dim in zip(idx, shape))
+        by_shard.setdefault(key, []).append(row_of[d])
+    return [sorted(rows) for _, rows in sorted(by_shard.items())]
+
+
 def batch_sharded(mesh, axis: str = DATA_AXIS):
     """NamedSharding splitting the leading (batch) dim across ``axis`` —
     per-replica input semantics (SURVEY.md D14)."""

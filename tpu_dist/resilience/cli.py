@@ -284,8 +284,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="Fault-injection chaos runner for tpu_dist training "
                     "jobs: baseline run, supervised chaos run, JSON report.")
     p.add_argument("--plan", required=True,
-                   help="fault plan: compact spec (kill-worker@step5), "
-                        "inline JSON, or @path/to/plan.json")
+                   help="fault plan: compact spec (kill-worker@step5; "
+                        "bitflip additionally takes leaf/shard coordinates, "
+                        "e.g. bitflip@step9:leaf1:replica5), inline JSON, "
+                        "or @path/to/plan.json")
     p.add_argument("--entry", default=None,
                    help="module:callable to train with (default: the "
                         "built-in synthetic-MNIST demo)")

@@ -17,6 +17,7 @@ grammar used on the CLI::
     nan_loss@step5                 # poison the step-5 batch with NaN
     grad_spike@step5               # scale the step-5 batch into a grad spike
     bitflip@step9:rank1            # flip one param bit on replica/rank 1
+    bitflip@step9:leaf2:replica5   # flip a bit in leaf 2's shard on device 5
     corrupt_batch@step5            # garbage the step-5 batch (finite, huge)
     engine_crash@req4              # kill the serve engine at the 4th completion
     decode_stall@req2:2s           # hang a decode step 2 s mid-serve
@@ -73,10 +74,15 @@ Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
     no process exit, no gang restart.
 ``bitflip``
     Silent data corruption: flip one mantissa bit of one parameter leaf on
-    one replica (``:rankR`` = the replica/rank index; in single-process
-    multi-device runs it names the local replica). Nothing crashes and the
-    loss stays plausible — only the cross-replica SDC audit's checksum
-    compare can see it.
+    one device (``:rankR`` = the replica/rank index; in single-process
+    multi-device runs it names the local replica). Leaf- and
+    shard-addressable: ``:leafK`` picks parameter leaf K (flatten order,
+    default 0) and ``:replicaR`` the device position R — so a plan can
+    corrupt exactly one shard of a TP-sharded kernel
+    (``bitflip@step9:leaf2:replica5``). The flip is dtype-aware (bf16
+    flips a top-mantissa bit, not a numerically invisible low byte bit).
+    Nothing crashes and the loss stays plausible — only the SDC audit's
+    shard-group checksum compare can see it.
 ``engine_crash`` / ``decode_stall`` / ``request_storm``
     SERVE-path faults, addressed by the request coordinate ``@reqN``
     instead of a training step (dispatch lives in
@@ -278,6 +284,8 @@ class FaultSpec:
     count: int = 1                  # how many times it fires (ckpt/slow kinds)
     mode: str = "transient"         # checkpoint_fail: transient | truncate
     exit_code: int = EXIT_FAULT_KILL
+    leaf: Optional[int] = None      # bitflip: param leaf index (flatten order)
+    replica: Optional[int] = None   # bitflip: device position for the flip
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -308,6 +316,11 @@ class FaultSpec:
             raise ValueError(
                 f"fault {self.kind!r} is not a job kind; @jobN targets "
                 f"only {sorted(JOB_KINDS)}")
+        if ((self.leaf is not None or self.replica is not None)
+                and self.kind != "bitflip"):
+            raise ValueError(
+                f"fault {self.kind!r} does not take :leafK/:replicaR; "
+                f"those address only bitflip")
         if self.kind == "checkpoint_fail" and self.mode not in (
                 "transient", "truncate"):
             raise ValueError(
@@ -441,6 +454,10 @@ def _parse_compact(spec: str) -> FaultSpec:
             # Job kinds: the in-job step the fault fires at (the @target
             # slot is taken by the job coordinate).
             kwargs["step"] = int(mod[4:])
+        elif mod.startswith("replica") and mod[7:].isdigit():
+            kwargs["replica"] = int(mod[7:])
+        elif mod.startswith("leaf") and mod[4:].isdigit():
+            kwargs["leaf"] = int(mod[4:])
         elif mod == "abort":
             kwargs["exit_code"] = EXIT_JOB_ABORT
         elif mod == "always":
@@ -474,5 +491,9 @@ def describe(plan: FaultPlan) -> Sequence[str]:
                  else f"epoch {f.epoch}")
         when = ("every attempt" if f.attempt is None
                 else f"attempt {f.attempt}")
-        out.append(f"{f.kind} @ {where} on rank {f.rank} ({when})")
+        addr = ""
+        if f.leaf is not None or f.replica is not None:
+            addr = (f" [leaf {0 if f.leaf is None else f.leaf}"
+                    f", replica {f.rank if f.replica is None else f.replica}]")
+        out.append(f"{f.kind} @ {where} on rank {f.rank} ({when}){addr}")
     return out

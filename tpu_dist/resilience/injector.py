@@ -182,10 +182,12 @@ class FaultInjector(Callback):
                           seconds=f.seconds)
                 time.sleep(f.seconds)
             elif f.kind == "bitflip":
-                # Silent data corruption: flip one mantissa bit of one
-                # replica's copy of the first parameter leaf. Nothing in
-                # the step will notice — only the cross-replica SDC audit
-                # can. The flipped state is consumed by the NEXT dispatch.
+                # Silent data corruption: flip one bit of one device's
+                # copy/shard of the addressed parameter leaf (:leafK,
+                # default 0; :replicaR, default the fault's rank). Nothing
+                # in the step will notice — only the SDC audit's
+                # shard-group checksum compare can. The flipped state is
+                # consumed by the NEXT dispatch.
                 self._remaining[i] -= 1
                 trainer = getattr(self.model, "_trainer", None)
                 if trainer is None or trainer.variables is None:
@@ -193,10 +195,13 @@ class FaultInjector(Callback):
                               reason="no live trainer variables")
                     continue
                 info = integrity_mod().flip_param_bit(
-                    trainer.variables, replica=f.rank)
+                    trainer.variables,
+                    replica=f.rank if f.replica is None else f.replica,
+                    leaf=0 if f.leaf is None else f.leaf)
                 self._log("fault_fired", kind="bitflip", step=gstep, **info)
-                logger.warning("fault injection: flipped bit %d of %s on "
-                               "replica %d at step %d", info["bit"],
+                logger.warning("fault injection: flipped bit %d (effective "
+                               "%d) of %s on replica %d at step %d",
+                               info["bit"], info["effective_bit"],
                                info["leaf"], info["replica"], gstep)
 
     def _fire_kill(self, i: int, f: FaultSpec, *, at: str,
@@ -312,6 +317,28 @@ class FaultInjector(Callback):
                       window_start=first_gstep, window=k)
             logger.warning("fault injection: %s poisoning batch window "
                            "[%d, %d)", f.kind, first_gstep, first_gstep + k)
+            if jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer):
+                # Token batches (LMs): an int id stream has no NaN to
+                # multiply in, and embedding reads clamp out-of-range ids,
+                # so poisoning x alone would be silently absorbed. Real
+                # buffer corruption of an id batch lands out-of-range
+                # LABELS too, and the label gather's fill semantics
+                # (take_along_axis) surface those as a nonfinite loss the
+                # guard catches — so poison y far outside any vocab;
+                # corrupt_batch/grad_spike additionally garble x so the
+                # poisoned window provably trained on different tokens.
+                bad = jnp.asarray(2 ** 30, jnp.asarray(y).dtype)
+                garble = jnp.asarray(-7, jnp.asarray(x).dtype)
+                if k > 1 and f.step - first_gstep < x.shape[0]:
+                    s = f.step - first_gstep
+                    y = y.at[s].set(bad)
+                    if f.kind != "nan_loss":
+                        x = x.at[s].multiply(garble)
+                else:
+                    y = jnp.full_like(y, bad)
+                    if f.kind != "nan_loss":
+                        x = x * garble
+                continue
             if f.kind == "nan_loss":
                 scale = jnp.asarray(float("nan"), x.dtype)
             elif f.kind == "grad_spike":

@@ -539,16 +539,26 @@ class Supervisor:
                 break
             dead = [r for r in survivors if procs[r].poll() is not None]
             if dead:
+                # Reform-during-reform: a SECOND rank died while the
+                # survivors were draining. The reform can never complete
+                # (the dead survivor will not ack), and its request must
+                # not outlive the attempt — a restarted gang's rejoin gate
+                # reading the stale g+1 request would re-enter a reform
+                # nobody mediates. Withdraw it and condemn the attempt to
+                # an ordinary gang restart.
+                bootstrap.withdraw_reform(self.step_rejoin_dir)
                 self._log("gang_reform_failed", attempt=attempt,
                           generation=new_gen, reason="survivor_died",
-                          ranks=dead)
+                          cause="second_loss", ranks=dead)
                 logger.warning("supervisor: survivor rank(s) %s died "
-                               "mid-reform; falling back to gang restart",
-                               dead)
+                               "mid-reform (second loss); falling back to "
+                               "gang restart", dead)
                 return False
             if time.monotonic() > ack_deadline:
+                bootstrap.withdraw_reform(self.step_rejoin_dir)
                 self._log("gang_reform_failed", attempt=attempt,
                           generation=new_gen, reason="ack_timeout",
+                          cause="ack_timeout",
                           acked=sorted(acks), survivors=survivors)
                 logger.warning(
                     "supervisor: reform acks %s/%s within %.1fs; falling "

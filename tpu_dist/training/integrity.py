@@ -18,18 +18,25 @@ execution's vector — the same one-behind lazy-fetch discipline as
 Thresholds: any non-finite is absolute; grad-norm is judged relative to an
 EMA of its own history (``spike_factor`` × EMA after ``warmup`` clean steps).
 
-**Cross-replica SDC audit.** Every ``audit_every_n`` steps the guard runs a
-collective-FREE compiled program (``shard_map`` over the whole mesh, inputs
-replicated, one output row per device) that checksums the parameter tree
-per replica: leaf bytes are bitcast to ``uint32`` and wrap-summed, giving a
-``[n_devices, n_leaves]`` table. Rows are compared ON HOST through the
-existing collectives seam (:func:`~tpu_dist.parallel.collectives.
-host_all_gather`): the common case is one equality check of the per-device
-totals; on mismatch the per-leaf columns name the corrupted leaf and
-replica/rank. Replicated training makes this divergence otherwise
-invisible — every replica keeps producing plausible losses. Tensor-/
-pipeline-/expert-parallel meshes are skipped (params are not replicated
-per-device there; see ROADMAP open items).
+**Cross-replica SDC audit (shard-aware).** Every ``audit_every_n`` steps the
+guard runs a collective-FREE compiled program (``shard_map`` over the whole
+mesh, one output row per device) that checksums the parameter tree per
+device: leaf bytes are bitcast to ``uint32`` and wrap-summed, giving a
+``[n_devices, n_leaves]`` table. Sharded leaves are consumed SHARD-LOCALLY
+(``in_specs`` taken from each leaf's live ``NamedSharding``), so TP/
+pipeline/MoE params audit just like replicated ones and the program still
+contains no collective. Rows are compared ON HOST through the existing
+collectives seam (:func:`~tpu_dist.parallel.collectives.host_all_gather`)
+*within shard groups* derived from the same shardings
+(:func:`~tpu_dist.parallel.mesh.shard_groups`): devices holding the same
+shard of a leaf are replicas of that shard and must agree — a TP-sharded
+kernel has one group per shard (column block), a replicated bias one global
+group. On mismatch the per-leaf columns name the corrupted leaf,
+shard-group, device and rank. Replicated training makes this divergence
+otherwise invisible — every replica keeps producing plausible losses. A
+leaf shard held by only ONE device has no replica to compare against; its
+singleton group is vacuously consistent (on real meshes the data axis
+replicates every shard).
 
 **Rollback-and-replay.** A confirmed anomaly raises
 :class:`RollbackAndReplay`; ``Trainer.fit`` catches it, restores the last
@@ -58,6 +65,15 @@ CLI for integrity plans):
                                     already triggered a rollback instead of
                                     re-running it (breaks exact replay
                                     parity; for data-dependent poison)
+``TPU_DIST_INTEGRITY_LOSS_SCALE``   static loss scale S: grad norms are
+                                    divided by S before the spike EMA, so
+                                    scaled-loss training is judged in true
+                                    gradient units (default 1)
+``TPU_DIST_INTEGRITY_BF16_SLACK``   spike-factor multiplier applied when the
+                                    param tree is low-precision (bf16/f16)
+                                    — quantization makes grad norms
+                                    noisier, so the threshold widens
+                                    instead of false-positives (default 4)
 ==================================  =========================================
 
 The module also owns the BATCH-fault seam (:func:`install_batch_fault_hook`)
@@ -90,6 +106,12 @@ SPIKE_ENV = "TPU_DIST_INTEGRITY_SPIKE"
 AUDIT_N_ENV = "TPU_DIST_INTEGRITY_AUDIT_N"
 BUDGET_ENV = "TPU_DIST_INTEGRITY_BUDGET"
 QUARANTINE_ENV = "TPU_DIST_INTEGRITY_QUARANTINE"
+LOSS_SCALE_ENV = "TPU_DIST_INTEGRITY_LOSS_SCALE"
+BF16_SLACK_ENV = "TPU_DIST_INTEGRITY_BF16_SLACK"
+
+#: Param dtypes whose quantization noise warrants the wider
+#: ``bf16_spike_slack`` threshold.
+_LOW_PRECISION_DTYPES = ("bfloat16", "float16")
 
 
 class RollbackAndReplay(Exception):
@@ -185,15 +207,20 @@ def reduce_window_health(healths):
 
 # -- cross-replica SDC audit --------------------------------------------------
 
-def build_audit_checksum(mesh, leaf_shapes_dtypes):
-    """The compiled per-replica checksum program for one param-tree layout.
+def build_audit_checksum(mesh, leaf_shapes_dtypes, leaf_specs=None):
+    """The compiled per-device checksum program for one param-tree layout.
 
-    A ``shard_map`` over the WHOLE mesh with replicated inputs: every device
-    checksums its own local copy of each leaf (bytes bitcast to ``uint32``,
-    wrap-summed) and contributes one ``[1, n_leaves]`` row; rows concatenate
-    across devices to the global ``[n_devices, n_leaves]`` table. No
-    collective appears in the program — the comparison happens on host —
-    so its baselined comm payload is exactly 0 bytes.
+    A ``shard_map`` over the WHOLE mesh: every device checksums its own
+    local copy — or, for sharded leaves, its own SHARD — of each leaf
+    (bytes bitcast to ``uint32``, wrap-summed) and contributes one
+    ``[1, n_leaves]`` row; rows concatenate across devices to the global
+    ``[n_devices, n_leaves]`` table. ``leaf_specs`` carries one
+    ``PartitionSpec`` per leaf taken from the live arrays' shardings
+    (``None`` = all replicated, the pre-shard-aware behavior); devices
+    holding the same shard produce equal checksums, which is exactly the
+    shard-group comparison :meth:`IntegrityGuard.audit` runs on host. No
+    collective appears in the program — so its baselined comm payload is
+    exactly 0 bytes, replicated and sharded alike.
     """
     import jax.numpy as jnp
     from jax.experimental.shard_map import shard_map
@@ -201,6 +228,8 @@ def build_audit_checksum(mesh, leaf_shapes_dtypes):
 
     names = tuple(mesh.axis_names)
     n_leaves = len(leaf_shapes_dtypes)
+    if leaf_specs is None:
+        leaf_specs = tuple(P() for _ in range(n_leaves))
 
     def per_device(*leaves):
         sums = []
@@ -212,47 +241,86 @@ def build_audit_checksum(mesh, leaf_shapes_dtypes):
         return jnp.stack(sums).reshape(1, n_leaves)
 
     shmapped = shard_map(per_device, mesh=mesh,
-                         in_specs=tuple(P() for _ in range(n_leaves)),
+                         in_specs=tuple(leaf_specs),
                          out_specs=P(names), check_rep=False)
     return jax.jit(shmapped)
 
 
-def flip_param_bit(variables: dict, *, replica: int, bit: int = 22) -> dict:
-    """Inject silent data corruption: XOR one mantissa bit of element 0 of
-    the first parameter leaf, on ONE replica's copy only.
+def _leaf_audit_spec(leaf, mesh):
+    """The audit ``in_spec`` for one live leaf: its own PartitionSpec when
+    it is a NamedSharding over the audited mesh, else replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
 
-    Used by the ``bitflip`` fault kind. Rebuilds the (nominally replicated)
-    array from per-device buffers via
+    sh = getattr(leaf, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+        return PartitionSpec(*sh.spec)
+    return PartitionSpec()
+
+
+def _leaf_shard_groups(leaf, mesh):
+    """Shard groups (lists of checksum-table row indices) for one leaf —
+    one global group when the leaf is not sharded over this mesh."""
+    from jax.sharding import NamedSharding
+
+    from tpu_dist.parallel import mesh as mesh_lib
+
+    sh = getattr(leaf, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+        return mesh_lib.shard_groups(sh, leaf.shape)
+    return [list(range(mesh.devices.size))]
+
+
+#: Unsigned view dtype per element width for the dtype-aware bit flip.
+_FLIP_VIEWS = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def flip_param_bit(variables: dict, *, replica: int, bit: int = 22,
+                   leaf: int = 0) -> dict:
+    """Inject silent data corruption: XOR one bit of element 0 of parameter
+    leaf ``leaf`` (flatten order), on ONE device's copy/shard only.
+
+    Used by the ``bitflip`` fault kind (``bitflip@stepN:leafK:replicaR``).
+    Rebuilds the array from per-device local buffers via
     ``jax.make_array_from_single_device_arrays`` so exactly one device's
-    copy diverges — the SDC model: nothing crashes, the loss stays
-    plausible, only a cross-replica checksum can see it. In multi-process
-    runs the caller has already matched the fault's rank to this process,
-    so the flip lands on local replica 0; single-process multi-device runs
-    use ``replica`` as the local device index. Returns a description of
-    what was flipped (leaf name, replica, bit) for the event log.
+    data diverges — the SDC model: nothing crashes, the loss stays
+    plausible, only a cross-replica checksum can see it. For a SHARDED
+    leaf the flip lands in that one device's shard, so the audit must
+    localize it to the right shard group. In multi-process runs the caller
+    has already matched the fault's rank to this process, so the flip
+    lands on local replica 0; single-process multi-device runs use
+    ``replica`` as the device position (sorted by device id, which matches
+    the mesh row order the audit reports).
+
+    The flip is dtype-aware: ``bit`` is taken modulo the element width, on
+    an unsigned view of matching width — so the default ``bit=22`` hits
+    f32 mantissa bit 22 and bf16 bit ``22 % 16 == 6``, the TOP mantissa
+    bit (a ~2^-1 relative change). A byte-wise flip here would land on a
+    numerically invisible low bf16 mantissa bit. Returns a description of
+    what was flipped — including the ``effective_bit`` — for the event
+    log.
     """
     params = variables["params"]
     flat, treedef = jax.tree_util.tree_flatten(params)
     paths = jax.tree_util.tree_flatten_with_path(params)[0]
-    arr = flat[0]
-    leaf_name = jax.tree_util.keystr(paths[0][0])
+    leaf_idx = int(leaf) % len(flat)
+    arr = flat[leaf_idx]
+    leaf_name = jax.tree_util.keystr(paths[leaf_idx][0])
     shards = sorted(arr.addressable_shards, key=lambda s: s.device.id)
     datas = [np.array(s.data) for s in shards]
     idx = 0 if jax.process_count() > 1 else replica % len(datas)
     buf = datas[idx].reshape(-1)
-    if buf.dtype == np.float32:
-        view = buf.view(np.uint32)
-        view[0] ^= np.uint32(1 << bit)
-    else:  # generic fallback: flip a low bit of the first byte
-        view = buf.view(np.uint8)
-        view[0] ^= np.uint8(1 << (bit % 8))
+    width = buf.dtype.itemsize * 8
+    view = buf.view(_FLIP_VIEWS[buf.dtype.itemsize])
+    eff_bit = int(bit) % width
+    view[0] ^= view.dtype.type(1 << eff_bit)
     rebuilt = jax.make_array_from_single_device_arrays(
         arr.shape, arr.sharding,
-        [jax.device_put(d.reshape(arr.shape), s.device)
-         for d, s in zip(datas, shards)])
-    flat[0] = rebuilt
+        [jax.device_put(d, s.device) for d, s in zip(datas, shards)])
+    flat[leaf_idx] = rebuilt
     variables["params"] = jax.tree_util.tree_unflatten(treedef, flat)
-    return {"leaf": leaf_name, "replica": idx, "bit": bit}
+    return {"leaf": leaf_name, "leaf_index": leaf_idx, "replica": idx,
+            "device": int(shards[idx].device.id), "bit": int(bit),
+            "effective_bit": eff_bit, "dtype": str(buf.dtype)}
 
 
 # -- the guard ----------------------------------------------------------------
@@ -265,6 +333,8 @@ class IntegrityConfig:
     audit_every_n: int = 0         # SDC-audit period in global steps; 0 = off
     rollback_budget: int = 3       # rollbacks before IntegrityAbort
     quarantine: bool = False       # skip-and-log windows that caused rollback
+    loss_scale: float = 1.0        # grad norms divided by this before the EMA
+    bf16_spike_slack: float = 4.0  # spike-factor multiplier on bf16/f16 params
 
     @classmethod
     def from_env(cls) -> "IntegrityConfig":
@@ -279,6 +349,8 @@ class IntegrityConfig:
             audit_every_n=int(_f(AUDIT_N_ENV, 0)),
             rollback_budget=int(_f(BUDGET_ENV, 3)),
             quarantine=os.environ.get(QUARANTINE_ENV) == "1",
+            loss_scale=_f(LOSS_SCALE_ENV, 1.0),
+            bf16_spike_slack=_f(BF16_SLACK_ENV, 4.0),
         )
 
 
@@ -308,6 +380,12 @@ class IntegrityGuard:
         self._audit_fn = None
         self._audit_key = None
         self._audit_paths = None
+        self._audit_groups = None
+        self._audit_devices = None
+        #: Low-precision param trees get the bf16_spike_slack threshold;
+        #: detected once from the first execution's params.
+        self._low_precision = False
+        self._lp_known = False
 
     def bind(self, strategy, *, checkpoint_dir=None) -> "IntegrityGuard":
         self._strategy = strategy
@@ -331,6 +409,11 @@ class IntegrityGuard:
             health.copy_to_host_async()
         except AttributeError:  # plain numpy in unit tests
             pass
+        if params is not None and not self._lp_known:
+            self._lp_known = True
+            self._low_precision = any(
+                str(getattr(l, "dtype", "")) in _LOW_PRECISION_DTYPES
+                for l in jax.tree_util.tree_leaves(params))
         if prev is not None:
             self._judge(*prev)
         n = self.cfg.audit_every_n
@@ -380,12 +463,19 @@ class IntegrityGuard:
                 or not math.isfinite(usq)):
             self._anomaly("nan_loss", first_gstep, k,
                           nonfinite=nonfinite)
-        gnorm = math.sqrt(max(gsq, 0.0))
+        # Loss-scaled training reports S x larger raw grad norms; dividing
+        # by the static scale judges (and logs) in true gradient units.
+        gnorm = (math.sqrt(max(gsq, 0.0))
+                 / max(float(self.cfg.loss_scale), 1e-30))
+        factor = self.cfg.spike_factor
+        if self._low_precision:
+            factor *= max(float(self.cfg.bf16_spike_slack), 1.0)
         if (self._ema is not None and self._ema_n >= self.cfg.warmup_steps
-                and gnorm > self.cfg.spike_factor * max(self._ema, 1e-12)):
+                and gnorm > factor * max(self._ema, 1e-12)):
             self._anomaly("grad_spike", first_gstep, k,
                           grad_norm=round(gnorm, 6),
-                          ema=round(self._ema, 6))
+                          ema=round(self._ema, 6),
+                          factor=round(factor, 6))
         d = self.cfg.ema_decay
         self._ema = gnorm if self._ema is None else d * self._ema + (1 - d) * gnorm
         self._ema_n += 1
@@ -415,61 +505,68 @@ class IntegrityGuard:
 
     # -- SDC audit -----------------------------------------------------------
 
-    def _auditable(self) -> bool:
-        s = self._strategy
-        if s is None:
-            return False
-        if (getattr(s, "model_parallel", False)
-                or getattr(s, "pipeline_parallel", False)
-                or getattr(s, "expert_parallel", False)):
-            # Params are SHARDED per-device on these meshes; a per-device
-            # checksum of different shards tells us nothing about SDC.
-            # ROADMAP open item: shard-aware audit.
-            return False
-        return True
-
     def audit(self, params, *, gstep: int) -> bool:
-        """One cross-replica checksum compare; True when replicas agree.
+        """One shard-group checksum compare; True when every group agrees.
 
+        Devices holding the same shard of a leaf (per its live
+        NamedSharding) are replicas of that shard and must produce equal
+        checksums; replicated leaves form one global group — so the audit
+        covers TP/pipeline/MoE param trees, not just mirrored ones.
         Disagreement is a confirmed SDC anomaly: the per-leaf "bisection"
-        names the corrupted leaf and replica from the already-computed
-        table (no extra dispatch), then the rollback machinery takes over.
+        names the corrupted leaf, shard-group, device and rank from the
+        already-computed table (no extra dispatch), then the rollback
+        machinery takes over.
         """
-        if not self._auditable():
-            if self._audit_key != "skipped":
-                self._audit_key = "skipped"
-                logger.info("integrity audit skipped: params are not "
-                            "replicated per-device on this mesh")
+        mesh = getattr(self._strategy, "mesh", None)
+        if mesh is None:
             return True
         t0 = time.perf_counter()
         flat_with_paths = jax.tree_util.tree_flatten_with_path(params)[0]
         leaves = [leaf for _, leaf in flat_with_paths]
-        key = tuple((tuple(l.shape), str(l.dtype)) for l in leaves)
+        specs = tuple(_leaf_audit_spec(leaf, mesh) for leaf in leaves)
+        key = tuple((tuple(l.shape), str(l.dtype), str(s))
+                    for l, s in zip(leaves, specs))
         if self._audit_fn is None or self._audit_key != key:
-            self._audit_fn = build_audit_checksum(self._strategy.mesh, key)
+            self._audit_fn = build_audit_checksum(mesh, key, specs)
             self._audit_key = key
             self._audit_paths = [jax.tree_util.keystr(p)
                                  for p, _ in flat_with_paths]
+            self._audit_groups = [_leaf_shard_groups(leaf, mesh)
+                                  for leaf in leaves]
+            self._audit_devices = [(int(d.id), int(d.process_index))
+                                   for d in mesh.devices.flat]
         table = self._audit_fn(*leaves)
         rows = self._host_rows(table)
-        ok = bool((rows == rows[0]).all())
         dt = time.perf_counter() - t0
         from tpu_dist.observe import metrics as metrics_lib
 
         metrics_lib.observe_value("integrity.audit_s", dt)
-        if ok:
-            return True
-        # Bisection: name every (replica, leaf) cell that deviates from the
-        # column's majority value.
+        # Bisection: name every (device, leaf) cell that deviates from its
+        # SHARD GROUP's majority value. A group with no strict majority
+        # (e.g. one corrupted member out of two) localizes the mismatch to
+        # the group, so every member is named.
         culprits = []
-        for col in range(rows.shape[1]):
-            vals, counts = np.unique(rows[:, col], return_counts=True)
-            majority = vals[int(np.argmax(counts))]
-            for row in np.nonzero(rows[:, col] != majority)[0]:
-                culprits.append({"replica": int(row),
-                                 "rank": int(row) // max(
-                                     1, rows.shape[0] // jax.process_count()),
-                                 "leaf": self._audit_paths[col]})
+        for col, groups in enumerate(self._audit_groups):
+            for gi, members in enumerate(groups):
+                vals = rows[members, col]
+                if bool((vals == vals[0]).all()):
+                    continue
+                uniq, counts = np.unique(vals, return_counts=True)
+                if int(counts.max()) * 2 > len(members):
+                    majority = uniq[int(np.argmax(counts))]
+                    bad = [m for m, v in zip(members, vals)
+                           if v != majority]
+                else:
+                    bad = list(members)
+                for row in bad:
+                    dev_id, rank = self._audit_devices[row]
+                    culprits.append({"replica": int(row),
+                                     "device": dev_id,
+                                     "rank": rank,
+                                     "shard_group": gi,
+                                     "leaf": self._audit_paths[col]})
+        if not culprits:
+            return True
         from tpu_dist.resilience import events
 
         events.maybe_log("integrity_sdc", step=gstep, culprits=culprits,
