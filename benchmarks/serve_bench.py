@@ -44,6 +44,16 @@ Paged dimension (two more phases, same exit-1 gates)
   time; prefix-cache hits skip the shared pages at prefill, so warm
   TTFT p50 must be <= ``--prefix-ttft-frac`` (default 0.5) of cold.
 
+Long-prompt dimension (chunked prefill, same exit-1 gates)
+----------------------------------------------------------
+Short decode-heavy streams in flight, long prompts arriving mid-flight,
+the same seeded backlog driven through an unchunked and a
+``prefill_chunk=N`` engine: both legs must complete everything with
+token-identical greedy streams, and the chunked leg's p99 inter-token
+gap over the short streams must be <= ``--chunked-p99-frac`` (default
+0.5) of the unchunked leg's — the head-of-line-blocking number chunked
+prefill exists to fix.
+
 Writes ``BENCH_SERVE.json`` (see ``--out``).
 """
 
@@ -229,10 +239,10 @@ def _measure_prefix(args) -> dict:
 
     def ttft(prompt):
         # Client-observed time to the first (and only) token. The
-        # engine's internal ttft_s stamps before the async dispatch
-        # resolves, so wall-clock around the request is the honest
-        # number — run_until_idle returns only after the token is host-
-        # side, and with max_new_tokens=1 that IS first-token latency.
+        # engine's internal ttft_s now stamps at first-token readback
+        # (tests pin that it tracks this wall clock), but the wall clock
+        # around the request stays the measured number here — it is what
+        # a caller experiences, submit overhead included.
         t0 = time.monotonic()
         engine.submit(prompt, max_new_tokens=1)
         engine.run_until_idle()
@@ -266,6 +276,92 @@ def _measure_prefix(args) -> dict:
     }
 
 
+def _measure_longprompt(args) -> dict:
+    """Head-of-line blocking under long-prompt arrival, chunked vs
+    unchunked prefill, same seeded backlog: short decode-heavy streams
+    get a few steps in flight, then long prompts land mid-flight. In the
+    unchunked leg each long prompt's whole-prompt causal pass runs
+    between two decode steps — every in-flight stream's inter-token gap
+    at that step eats the entire prefill. The chunked leg splits it into
+    ``--prefill-chunk`` chunks, at most one per decode step, so the worst
+    gap is bounded by one chunk's compute. Gates: both legs complete
+    everything, greedy streams are token-identical (chunking never
+    reorders attention), and the chunked leg's p99 inter-token gap over
+    the short streams is <= ``--chunked-p99-frac`` of the unchunked
+    leg's."""
+    from tpu_dist.models.transformer import build_transformer_lm
+    from tpu_dist.serve.engine import ServeEngine
+
+    seq_len = 512
+    rng = np.random.default_rng(args.seed + 3)
+    shorts = [{"prompt": rng.integers(
+                   0, VOCAB, size=int(rng.integers(4, 9))).tolist(),
+               "max_new_tokens": 24} for _ in range(3)]
+    longs = [{"prompt": rng.integers(0, VOCAB, size=448).tolist(),
+              "max_new_tokens": 4} for _ in range(2)]
+    arrive_at = (4, 10)  # decode steps before each long prompt lands
+
+    def lm():
+        # The prefix-phase model size: big enough that a whole-prompt
+        # prefill dwarfs per-step dispatch overhead — the cost being
+        # sliced is what this phase measures.
+        return build_transformer_lm(VOCAB, seq_len, d_model=256, depth=4,
+                                    num_heads=4)
+
+    def drive(engine):
+        reqs = [engine.submit(w["prompt"],
+                              max_new_tokens=w["max_new_tokens"])
+                for w in shorts]
+        seen = [0] * len(shorts)
+        stamps = [[] for _ in shorts]
+        pending = list(longs)
+        arrivals = list(arrive_at)
+        steps = 0
+        while not engine.scheduler.idle() or pending:
+            if pending and (steps >= arrivals[0]
+                            or engine.scheduler.idle()):
+                w = pending.pop(0)
+                arrivals.pop(0)
+                reqs.append(engine.submit(
+                    w["prompt"], max_new_tokens=w["max_new_tokens"]))
+            engine.step()
+            steps += 1
+            t = time.monotonic()
+            for i, r in enumerate(reqs[:len(shorts)]):
+                while seen[i] < len(r.generated):
+                    seen[i] += 1
+                    stamps[i].append(t)
+        gaps = [b - a for ts in stamps for a, b in zip(ts, ts[1:])]
+        # Keyed by submission order, not rid: the measured pass reuses
+        # the warmup engine, so its rids continue past the warmup's.
+        streams = {i: list(r.generated) for i, r in enumerate(reqs)}
+        completed = sum(1 for r in reqs if r.status == "done")
+        return gaps, streams, completed
+
+    out = {"short_requests": len(shorts), "long_requests": len(longs),
+           "long_prompt_tokens": len(longs[0]["prompt"]),
+           "prefill_chunk": args.prefill_chunk}
+    streams = {}
+    for name, chunk in (("unchunked", 0), ("chunked", args.prefill_chunk)):
+        engine = ServeEngine(lm(), max_batch=6, max_len=seq_len,
+                             seed=args.seed, prefill_chunk=chunk)
+        drive(engine)  # warmup: compiles every program this schedule runs
+        gaps, streams[name], completed = drive(engine)
+        out[name] = {
+            "completed": completed,
+            "requests": len(shorts) + len(longs),
+            "decode_gap_p99_s": round(float(np.quantile(gaps, 0.99)), 6),
+            "decode_gap_p50_s": round(float(np.quantile(gaps, 0.5)), 6),
+            "compiled_programs": engine.compiled_programs(),
+        }
+    p99_u = out["unchunked"]["decode_gap_p99_s"]
+    p99_c = out["chunked"]["decode_gap_p99_s"]
+    out["streams_match"] = streams["chunked"] == streams["unchunked"]
+    out["chunked_over_unchunked_p99"] = (round(p99_c / p99_u, 4)
+                                         if p99_u > 0 else None)
+    return out
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--requests", type=int, default=32)
@@ -285,6 +381,13 @@ def main(argv=None) -> int:
     p.add_argument("--prefix-ttft-frac", type=float, default=0.5,
                    help="gate: warm (prefix-hit) TTFT p50 must be <= "
                         "this fraction of cold TTFT p50")
+    p.add_argument("--prefill-chunk", type=int, default=32,
+                   help="chunk size for the long-prompt chunked-prefill "
+                        "leg (positions per chunk, power of two)")
+    p.add_argument("--chunked-p99-frac", type=float, default=0.5,
+                   help="gate: chunked-prefill p99 inter-token gap under "
+                        "long-prompt arrival must be <= this fraction of "
+                        "the unchunked engine's")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=str(pathlib.Path(__file__).parent.parent
                                         / "BENCH_SERVE.json"))
@@ -298,6 +401,8 @@ def main(argv=None) -> int:
     capacity = _measure_paged_capacity(args)
     print("measuring prefix-cache TTFT...", file=sys.stderr)
     prefix = _measure_prefix(args)
+    print("measuring long-prompt chunked prefill...", file=sys.stderr)
+    longprompt = _measure_longprompt(args)
 
     speedup = (continuous["throughput_tok_s"] / static["throughput_tok_s"]
                if static["throughput_tok_s"] else None)
@@ -322,6 +427,14 @@ def main(argv=None) -> int:
         "prefix_hit_ttft": (
             prefix["warm_over_cold"] is not None
             and prefix["warm_over_cold"] <= args.prefix_ttft_frac),
+        "longprompt_all_completed": all(
+            longprompt[leg]["completed"] == longprompt[leg]["requests"]
+            for leg in ("unchunked", "chunked")),
+        "longprompt_streams_match": longprompt["streams_match"],
+        "longprompt_chunked_p99": (
+            longprompt["chunked_over_unchunked_p99"] is not None
+            and longprompt["chunked_over_unchunked_p99"]
+            <= args.chunked_p99_frac),
     }
     report = {
         "bench": "serve",
@@ -336,6 +449,7 @@ def main(argv=None) -> int:
         "continuous": continuous,
         "paged_capacity": capacity,
         "prefix_cache": prefix,
+        "longprompt_chunked": longprompt,
         "continuous_over_static": (round(speedup, 4)
                                    if speedup is not None else None),
         "gates": gates,

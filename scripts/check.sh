@@ -110,7 +110,7 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/checkpoint_bench.py \
   || { echo "check.sh: checkpoint bench gates failed" \
        "(see BENCH_CHECKPOINT.json)" >&2; exit 1; }
 
-echo "== serve-bench: batching policies + paged KV capacity/prefix TTFT =="
+echo "== serve-bench: batching policies + paged KV + chunked prefill =="
 # Drives the identical seeded backlog through a continuous-batching and a
 # static-batching ServeEngine (warmup pass compiles every bucket first);
 # writes BENCH_SERVE.json. Gates: every request completed in BOTH modes
@@ -120,7 +120,11 @@ echo "== serve-bench: batching policies + paged KV capacity/prefix TTFT =="
 # engine streams token-identically, completes everything, and holds
 # >= 2x the concurrent requests (static pages/request math AND measured
 # peak concurrency), and prefix-cache hits land first tokens at
-# <= 0.5x the cold-prefill TTFT p50.
+# <= 0.5x the cold-prefill TTFT p50; PLUS the long-prompt dimension —
+# mid-stream long prompts through a chunked (prefill_chunk=32) and an
+# unchunked engine must all complete with token-identical streams, and
+# the chunked decode p99 inter-token gap must stay <= 0.5x unchunked
+# (chunking ends the long-prefill head-of-line stall).
 timeout -k 10 420 env JAX_PLATFORMS=cpu python benchmarks/serve_bench.py \
   >/dev/null \
   || { echo "check.sh: serve bench gates failed (see BENCH_SERVE.json)" >&2

@@ -78,6 +78,7 @@ class TestResNet:
         n = sum(x.size for x in jax.tree_util.tree_leaves(v["params"]))
         assert 10.5e6 < n < 11.5e6, n
 
+    @pytest.mark.slow  # ~40s of XLA compile for one CPU fit step
     def test_resnet50_builds_and_steps(self, eight_devices):
         s = td.MirroredStrategy()
         with s.scope():
@@ -92,6 +93,7 @@ class TestResNet:
         hist = model.fit(ds, epochs=1, steps_per_epoch=1, verbose=0)
         assert np.isfinite(hist.history["loss"][0])
 
+    @pytest.mark.slow  # ~90s compile+train on CPU; forward/param coverage above stays tier-1
     def test_resnet18_trains_on_separable_data(self, eight_devices):
         s = td.MirroredStrategy()
         with s.scope():

@@ -394,6 +394,43 @@ class TestServeEngine:
         assert stuck.finish_reason == "deadline"
         assert quick.status == "done" and len(quick.generated) == 2
 
+    def test_ttft_stamped_after_first_token_readback(self):
+        # The PR 12 wart: ttft_s was stamped before the async dispatch
+        # resolved, so a slow device->host readback was invisible to the
+        # internal metric while every client saw it. Simulate the
+        # readback cost by advancing the clock inside _pick and require
+        # the internal p50 to track the client-observed p50 (the time
+        # the token first becomes visible after step() returns).
+        clock = _FakeClock()
+        model, _ = _lm()
+        engine = ServeEngine(model, max_batch=2, max_len=32, clock=clock)
+        orig_pick = engine._pick
+
+        def slow_pick(logits):
+            clock.t += 1.0  # device->host readback cost
+            return orig_pick(logits)
+
+        engine._pick = slow_pick
+        reqs = [engine.submit([1, 2, 3], max_new_tokens=2)
+                for _ in range(4)]
+        client = {}
+        while not engine.scheduler.idle():
+            engine.step()
+            for i, r in enumerate(reqs):
+                if i not in client and r.generated:
+                    client[i] = clock.t - r.submit_s
+        internal = sorted(r.ttft_s for r in reqs)
+        observed = sorted(client.values())
+        internal_p50 = internal[len(internal) // 2]
+        observed_p50 = observed[len(observed) // 2]
+        # Internal stamps right at readback; the client can only be
+        # later (other slots' readbacks in the same step), never earlier,
+        # and each extra readback costs 1.0 fake second.
+        assert internal_p50 <= observed_p50
+        assert observed_p50 - internal_p50 <= len(reqs) * 1.0
+        for r in reqs:
+            assert r.ttft_s >= 1.0  # the readback itself is included
+
     def test_serve_metrics_recorded(self):
         from tpu_dist.observe import metrics
 

@@ -228,6 +228,19 @@ def cost_main(argv: Optional[list] = None) -> int:
         "--entries", default=None, metavar="NAME[,NAME...]",
         help="restrict to these built-in entry points (default: all)")
     parser.add_argument(
+        "--calibrate", action="store_true",
+        help="microbench THIS host (timed psum sweep + one timed matmul) "
+             "into per-axis link bandwidth/latency and a TFLOP/s rate, "
+             "emit the JSON, and exit; feed it back with --links")
+    parser.add_argument(
+        "--calibrate-out", default=None, metavar="PATH",
+        help="write the --calibrate JSON here instead of stdout")
+    parser.add_argument(
+        "--links", default=None, metavar="@PATH",
+        help="price with a calibration file from --calibrate (per-axis "
+             "links + flops rate); explicit --mesh link suffixes still "
+             "win for their axes")
+    parser.add_argument(
         "--baseline", default=None, metavar="PATH",
         help="diff against this committed baseline; comm growth past the "
              "tolerance is an SC301 error, peak HBM past budget an SC302 "
@@ -256,6 +269,25 @@ def cost_main(argv: Optional[list] = None) -> int:
     fmt = args.format or ("json" if args.json else "text")
     fail_on = "warning" if args.strict else "error"
     baseline_path = args.baseline or "ANALYSIS_BASELINE.json"
+
+    if args.calibrate:
+        import json
+
+        # Before _force_cpu_backend(): the whole point is measuring the
+        # backend this process actually has.
+        axis_names = (tuple(costmodel.parse_mesh(args.mesh))
+                      if args.mesh else ("data",))
+        spec = costmodel.calibrate(axis_names=axis_names or ("data",))
+        text = json.dumps(spec, indent=2, sort_keys=True) + "\n"
+        if args.calibrate_out:
+            with open(args.calibrate_out, "w") as f:
+                f.write(text)
+            print(f"wrote {args.calibrate_out}: backend "
+                  f"{spec['backend']} x{spec['device_count']}, "
+                  f"{spec['flops_per_s'] / 1e12:.3f} TFLOP/s")
+        else:
+            sys.stdout.write(text)
+        return 0
     for p in args.paths:
         if not os.path.exists(p):
             parser.error(f"no such path: {p}")
@@ -275,6 +307,15 @@ def cost_main(argv: Optional[list] = None) -> int:
     else:
         model_mesh = {}
 
+    flops_per_s = None
+    if args.links is not None:
+        path = args.links[1:] if args.links.startswith("@") else args.links
+        if not os.path.exists(path):
+            parser.error(f"no such calibration file: {path}")
+        file_links, flops_per_s = costmodel.load_links(path)
+        # Explicit --mesh suffixes override the file per axis.
+        links = {**file_links, **links}
+
     _force_cpu_backend()
     from tpu_dist.analysis import jaxpr_checks
 
@@ -292,7 +333,8 @@ def cost_main(argv: Optional[list] = None) -> int:
     traced, findings = jaxpr_checks.trace_entry_points(names)
     reports = {
         name: costmodel.analyze_jaxpr(
-            closed, entry=name, model_mesh=model_mesh, links=links)
+            closed, entry=name, model_mesh=model_mesh, links=links,
+            flops_per_s=flops_per_s)
         for name, closed in traced.items()}
 
     for p in args.paths:
@@ -308,7 +350,8 @@ def cost_main(argv: Optional[list] = None) -> int:
 
                 closed = jax.make_jaxpr(fn)(*fargs)
                 reports[label] = costmodel.analyze_jaxpr(
-                    closed, entry=label, model_mesh=model_mesh, links=links)
+                    closed, entry=label, model_mesh=model_mesh, links=links,
+                    flops_per_s=flops_per_s)
             except Exception as e:  # noqa: BLE001 - degrade, never crash
                 findings.append(Finding(
                     "SC900", f, 1, 0,

@@ -456,6 +456,110 @@ def estimate_latency(flops: int, collectives: Iterable[CollectiveCost],
         launches=launches, flops=int(flops))
 
 
+# -- calibration --------------------------------------------------------------
+#
+# The MODEL constants above make the cost model a relative-pricing tool.
+# ``calibrate()`` turns it absolute for THIS host: a timed psum sweep over
+# two payload sizes fits the same affine cost the latency model charges
+# per launch (latency intercept + wire_bytes/bandwidth slope, wire bytes
+# per the ring formulas in :func:`comm_bytes`), and one timed matmul pins
+# the sustained flop rate. The result round-trips through JSON so a CI box
+# can calibrate once and every later ``cost`` run prices against real
+# numbers via ``--links @file.json``.
+
+
+def calibrate(*, axis_names: Iterable[str] = ("data",),
+              payload_bytes: Iterable[int] = (1 << 18, 1 << 21),
+              matmul_dim: int = 512, repeats: int = 3) -> dict:
+    """Microbench the current backend into a link/compute spec dict.
+
+    Runs a psum over all local devices at each payload size (best of
+    ``repeats``, after a warmup that also absorbs compilation) and fits
+    ``t = latency + wire_bytes / bandwidth`` through the two endpoints,
+    with wire bytes from the same ring model :func:`estimate_latency`
+    charges — so feeding the result back reproduces the measured times.
+    On a single-device host the ring moves zero bytes, so the raw payload
+    stands in as the wire proxy (the copy that actually happens) and the
+    numbers mean "loopback", not fabric. Every requested axis gets the
+    same measured :class:`LinkSpec` — collective microbenches can't tell
+    mesh axes apart without a real multi-axis topology, and on one slice
+    they share the interconnect class anyway.
+
+    Returns a plain-JSON dict: ``{"backend", "device_count", "links":
+    {axis: {bandwidth_gbps, latency_us}}, "flops_per_s"}`` — the exact
+    shape :func:`load_links` reads.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.local_device_count()
+
+    def timed(fn, *args):
+        out = fn(*args)  # warmup: compile + first run
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(max(int(repeats), 1)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    psum = jax.pmap(lambda v: jax.lax.psum(v, "data"), axis_name="data")
+    points = []
+    for size in payload_bytes:
+        elems = max(int(size) // 4, 1)
+        x = jnp.zeros((n, elems), jnp.float32)
+        wire = comm_bytes("psum", elems * 4, n) or elems * 4
+        points.append((float(wire), timed(psum, x)))
+    (w0, t0), (w1, t1) = points[0], points[-1]
+    if w1 > w0 and t1 > t0:
+        bytes_per_s = (w1 - w0) / (t1 - t0)
+        latency_s = max(t0 - w0 / bytes_per_s, 0.0)
+    else:  # degenerate sweep: keep the model's slope, pin the intercept
+        bytes_per_s = DEFAULT_LINK_BANDWIDTH_GBPS * 1e9
+        latency_s = max(min(t0, t1), 0.0)
+    link = LinkSpec(bandwidth_gbps=bytes_per_s / 1e9,
+                    latency_us=latency_s * 1e6)
+
+    d = int(matmul_dim)
+    a = jnp.ones((d, d), jnp.float32)
+    mm = jax.jit(lambda x: x @ x)
+    mm_s = timed(mm, a)
+    flops_per_s = (2.0 * d * d * d) / max(mm_s, 1e-12)
+
+    return {
+        "backend": jax.default_backend(),
+        "device_count": n,
+        "links": {str(name): link.to_json() for name in axis_names},
+        "flops_per_s": flops_per_s,
+    }
+
+
+def load_links(path: str) -> tuple[dict, Optional[float]]:
+    """Read a :func:`calibrate` JSON file -> ``(links, flops_per_s)``.
+
+    ``links`` maps axis name -> :class:`LinkSpec`; ``flops_per_s`` is
+    ``None`` when the file carries no compute rate. Unknown top-level
+    keys are ignored so the file can carry provenance (backend, device
+    count) without breaking older readers.
+    """
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    links = {
+        str(name): LinkSpec(
+            bandwidth_gbps=float(spec.get(
+                "bandwidth_gbps", DEFAULT_LINK_BANDWIDTH_GBPS)),
+            latency_us=float(spec.get(
+                "latency_us", DEFAULT_LINK_LATENCY_US)))
+        for name, spec in dict(data.get("links", {})).items()}
+    flops = data.get("flops_per_s")
+    return links, (float(flops) if flops else None)
+
+
 def _boundary_bytes(jaxpr) -> int:
     core = getattr(jaxpr, "jaxpr", jaxpr)
     consts = getattr(jaxpr, "consts", ())
@@ -548,10 +652,17 @@ def arg_liveness(jaxpr) -> list:
 
 def analyze_jaxpr(closed, *, entry: str,
                   model_mesh: Optional[Mapping] = None,
-                  links: Optional[Mapping] = None) -> CostReport:
-    """The full cost-model verdict for one traced entry point."""
+                  links: Optional[Mapping] = None,
+                  flops_per_s: Optional[float] = None) -> CostReport:
+    """The full cost-model verdict for one traced entry point.
+
+    ``flops_per_s`` overrides the model's default compute rate (e.g. a
+    :func:`calibrate` measurement); ``None`` keeps the default."""
     colls = collect_collective_costs(closed, model_mesh=model_mesh)
-    latency = estimate_latency(collect_flops(closed), colls, links=links)
+    latency = estimate_latency(
+        collect_flops(closed), colls, links=links,
+        flops_per_s=(float(flops_per_s) if flops_per_s
+                     else DEFAULT_COMPUTE_FLOPS_PER_S))
     return CostReport(
         entry=entry,
         collectives=tuple(colls),

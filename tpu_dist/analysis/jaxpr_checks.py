@@ -684,6 +684,52 @@ def _trace_serve_paged_decode():
         params, pool, tables, tokens, lengths)
 
 
+def _trace_serve_prefill_chunk():
+    """``serve.kv_cache.prefill_chunk_step`` — one mid-prompt chunk of
+    the interleaved prefill: writes the chunk's K/V at a traced start
+    offset and attends over everything cached so far. Runs between
+    decode steps, so it inherits the decode-loop contract: pinned
+    collective-free, and its HBM baseline catches an accidental
+    whole-cache temporary (the chunk should touch one slot's rows
+    plus the shared weights, nothing cache-sized)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, cache = _serve_probe()
+    tokens = jnp.zeros((8,), jnp.int32)
+    # A non-degenerate mid-prompt chunk: start=8, valid through 12, pad
+    # to 16 == max_len (the caller-enforced bound).
+    return jax.make_jaxpr(
+        lambda p, c, t: kv_cache.prefill_chunk_step(
+            plan, p, c, t, jnp.int32(12), jnp.int32(0), jnp.int32(8)))(
+        params, cache, tokens)
+
+
+def _trace_serve_paged_prefill_chunk():
+    """``serve.paged_prefill_chunk`` — the paged chunked-prefill step.
+    Deliberately the SAME program as ``serve.paged_prefill`` called at a
+    mid-prompt (start > 0, length < prompt end) window: chunking on the
+    paged path reuses the traced-start seam instead of adding a kernel.
+    Pinned separately so a future 'optimization' that forks the chunked
+    call into its own program (doubling the compiled surface) or adds a
+    collective to it shows up as a baseline diff."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, _ = _serve_probe()
+    pool = kv_cache.init_page_pool(plan, num_pages=8, page_size=4)
+    page_row = jnp.zeros((4,), jnp.int32)
+    tokens = jnp.zeros((4,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, r, t: kv_cache.paged_prefill(
+            plan, p, c, r, t, jnp.int32(8), jnp.int32(4)))(
+        params, pool, page_row, tokens)
+
+
 def _trace_integrity_health_step():
     """The trainer step WITH the in-step health vector — same program the
     plain train_step entry traces (health_summary is always folded in), but
@@ -846,6 +892,8 @@ ENTRY_POINTS = {
     "serve.decode_step": _trace_serve_decode,
     "serve.paged_prefill": _trace_serve_paged_prefill,
     "serve.paged_decode_step": _trace_serve_paged_decode,
+    "serve.prefill_chunk_step": _trace_serve_prefill_chunk,
+    "serve.paged_prefill_chunk": _trace_serve_paged_prefill_chunk,
     "training.integrity.health_step": _trace_integrity_health_step,
     "training.integrity.audit_checksum": _trace_integrity_audit_checksum,
     "training.integrity.audit_checksum_sharded":

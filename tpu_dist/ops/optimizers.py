@@ -166,13 +166,19 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta_1: float = 0.9,
                  beta_2: float = 0.999, epsilon: float = 1e-7,
-                 clipnorm=None, clipvalue=None, global_clipnorm=None):
+                 clipnorm=None, clipvalue=None, global_clipnorm=None,
+                 fused: bool = False):
         from tpu_dist.ops import schedules
 
         self.learning_rate, self._scheduled = schedules.resolve(learning_rate)
         self.beta_1 = float(beta_1)
         self.beta_2 = float(beta_2)
         self.epsilon = float(epsilon)
+        # Opt-in Pallas path (ops/pallas_kernels.fused_adam_apply): both
+        # moment updates and the parameter step as one kernel over the
+        # flattened buffer. Unlike fused SGD, the bias-correction scale is
+        # a scalar operand, so scheduled learning rates fuse too.
+        self.fused = bool(fused)
         self._set_clipping(clipnorm, clipvalue, global_clipnorm)
 
     def init(self, params):
@@ -185,6 +191,15 @@ class Adam(Optimizer):
         lr = (self.learning_rate(state.step) if self._scheduled
               else self.learning_rate)
         step = state.step + 1
+        if self.fused:
+            from tpu_dist.ops.pallas_kernels import fused_adam_apply
+
+            t = step.astype(jnp.float32)
+            scale = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+            new_params, mu, nu = fused_adam_apply(
+                params, grads, state.mu, state.nu, scale=scale,
+                beta_1=b1, beta_2=b2, epsilon=eps)
+            return new_params, AdamState(step=step, mu=mu, nu=nu)
         mu = jax.tree_util.tree_map(
             lambda m, g: b1 * m + (1 - b1) * g, state.mu, grads)
         nu = jax.tree_util.tree_map(

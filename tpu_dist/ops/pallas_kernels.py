@@ -19,6 +19,13 @@ here:
   The win is launch/fusion overhead on many-leaf models, the same
   launch-count economics the bucketed all-reduce targets on the comm side.
 
+* :func:`fused_adam_apply` — the same packed-buffer treatment for Adam:
+  both moment updates and the bias-corrected parameter step in ONE kernel
+  (p, g, m, v + a (1, 1) scalar step-size in; p', m', v' out). The
+  bias-correction scale is a scalar *operand* rather than a baked
+  constant, so the step counter advancing never retraces the kernel and
+  scheduled learning rates work unchanged.
+
 Kernels run on TPU; every entry point takes ``interpret=`` (Pallas interpreter,
 used by the CPU test suite) and the public wrapper falls back to the plain
 jnp implementation on non-TPU backends, so the framework is correct
@@ -344,3 +351,97 @@ def _sgd_jnp(params, grads, velocity, *, lr, m, nesterov):
         new_params = jax.tree_util.tree_map(
             lambda p, v: p + v, params, new_vel)
     return new_params, new_vel
+
+
+# -- fused Adam update --------------------------------------------------------
+
+
+def _adam_kernel(b1, b2, eps, p_ref, g_ref, m_ref, v_ref, scale_ref,
+                 newp_ref, newm_ref, newv_ref):
+    """m/v moment update + bias-corrected parameter step, one pass.
+
+    The betas and epsilon bake into the program (fixed per optimizer
+    instance); the bias-correction scale ``lr * sqrt(1-b2^t)/(1-b1^t)``
+    depends on the traced step counter, so it rides in as a (1, 1)
+    scalar operand — one compiled kernel serves every step instead of
+    retracing as ``t`` advances."""
+    g = g_ref[:]
+    m = b1 * m_ref[:] + (1.0 - b1) * g
+    v = b2 * v_ref[:] + (1.0 - b2) * g * g
+    newm_ref[:] = m
+    newv_ref[:] = v
+    newp_ref[:] = p_ref[:] - scale_ref[0, 0] * m / (jnp.sqrt(v) + eps)
+
+
+def fused_adam_apply(params, grads, mu, nu, *, scale, beta_1: float = 0.9,
+                     beta_2: float = 0.999, epsilon: float = 1e-7,
+                     interpret: bool | None = None):
+    """One-kernel Adam update over a whole parameter pytree.
+
+    Returns ``(new_params, new_mu, new_nu)``. Math matches
+    :class:`tpu_dist.ops.optimizers.Adam` leaf-for-leaf — the update runs
+    in fp32 over the packed buffer and casts back per leaf, so non-fp32
+    leaves agree to allclose rather than bitwise. ``scale`` is the
+    bias-corrected step size ``lr * sqrt(1 - b2^t) / (1 - b1^t)`` — a
+    traced scalar is fine (scheduled learning rates included): it enters
+    the kernel as a scalar operand, not a baked constant, so step
+    advancement never retraces. ``beta_1``/``beta_2``/``epsilon`` must be
+    Python floats. Off-TPU the plain tree_map math runs unless
+    ``interpret=True`` forces the Pallas interpreter (the CPU-testable
+    path).
+    """
+    from jax.experimental import pallas as pl
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if interpret is None:
+        interpret = False
+        if not _on_tpu() or not leaves:
+            return _adam_jnp(params, grads, mu, nu, scale=scale,
+                             b1=beta_1, b2=beta_2, eps=epsilon)
+    if not leaves:
+        return _adam_jnp(params, grads, mu, nu, scale=scale,
+                         b1=beta_1, b2=beta_2, eps=epsilon)
+    from jax.experimental.pallas import tpu as pltpu
+
+    b1, b2, eps = float(beta_1), float(beta_2), float(epsilon)
+    p_buf, sizes, total = _flatten_padded(
+        [jnp.asarray(l) for l in leaves])
+    g_buf, _, _ = _flatten_padded(
+        [jnp.asarray(g) for g in jax.tree_util.tree_leaves(grads)])
+    m_leaves = [jnp.asarray(m) for m in jax.tree_util.tree_leaves(mu)]
+    n_leaves = [jnp.asarray(n) for n in jax.tree_util.tree_leaves(nu)]
+    m_buf, _, _ = _flatten_padded(m_leaves)
+    n_buf, _, _ = _flatten_padded(n_leaves)
+    scale_arr = jnp.asarray(scale, jnp.float32).reshape(1, 1)
+    rows = p_buf.shape[0]
+    tb = next(t for t in (128, 64, 32, 16, 8) if rows % t == 0)
+    space = pl.ANY if interpret else pltpu.VMEM
+    spec = pl.BlockSpec((tb, _SGD_LANES), lambda i: (i, 0),
+                        memory_space=space)
+    # Every grid step reads the same (1, 1) scale block — scalar memory
+    # on hardware, ANY under the interpreter.
+    sspec = pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pl.ANY if interpret else pltpu.SMEM)
+    new_p, new_m, new_n = pl.pallas_call(
+        functools.partial(_adam_kernel, b1, b2, eps),
+        grid=(rows // tb,),
+        in_specs=[spec, spec, spec, spec, sspec],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct(p_buf.shape, jnp.float32)] * 3,
+        interpret=interpret,
+    )(p_buf, g_buf, m_buf, n_buf, scale_arr)
+    return (_unflatten(new_p, leaves, sizes, total, treedef),
+            _unflatten(new_m, m_leaves, sizes, total, treedef),
+            _unflatten(new_n, n_leaves, sizes, total, treedef))
+
+
+def _adam_jnp(params, grads, mu, nu, *, scale, b1, b2, eps):
+    """The reference tree_map math (optimizers.Adam), for off-TPU calls."""
+    new_mu = jax.tree_util.tree_map(
+        lambda m, g: b1 * m + (1.0 - b1) * g, mu, grads)
+    new_nu = jax.tree_util.tree_map(
+        lambda n, g: b2 * n + (1.0 - b2) * jnp.square(g), nu, grads)
+    new_params = jax.tree_util.tree_map(
+        lambda p, m, n: p - scale * m / (jnp.sqrt(n) + eps),
+        params, new_mu, new_nu)
+    return new_params, new_mu, new_nu

@@ -175,6 +175,26 @@ class ServeEngine:
         not given, else guards the explicit pool the same way.
       prefix_caching: paged mode only — disable to keep paging without
         cross-request prefix sharing (parity baselines use this).
+      prefill_chunk: when > 0, split each admitted prompt's prefill into
+        chunks of this many positions (power of two >= 8) and interleave
+        them with decode steps, so one long prompt no longer stalls
+        every in-flight decode stream for a whole-prompt causal pass.
+        Each chunk attends over all prior cached positions — attention
+        is never reordered — so greedy streams stay token-identical to
+        whole-prompt prefill (tests + serve-bench pin it). A slot being
+        chunk-prefilled is excluded from decode (cursor on the request)
+        until its final chunk lands; the final chunk emits the first
+        token. Ragged final chunks pad to a power of two, so the chunk
+        program cache holds at most log2(prefill_chunk / 8) + 1
+        programs. Default 0: whole-prompt prefill, compiled programs and
+        scheduling byte-identical to previous behavior. Tune it to
+        roughly the per-step decode token budget: smaller chunks give
+        flatter inter-token latency, larger chunks finish long prompts
+        in fewer (cheaper-per-token) passes.
+      prefill_interleave: max prefill chunks run between consecutive
+        decode steps (default 1 — the flattest-latency policy). Chunks
+        drain arrival-ordered (the head request finishes before a later
+        one starts), so chunked prefill cannot starve anyone.
     """
 
     def __init__(self, model: Sequential, *, max_batch: int = 8,
@@ -189,7 +209,8 @@ class ServeEngine:
                  virtual_step_s: float = 0.0, paged: bool = False,
                  page_size: int = 16, num_pages: Optional[int] = None,
                  budget_bytes: Optional[int] = None,
-                 prefix_caching: bool = True):
+                 prefix_caching: bool = True, prefill_chunk: int = 0,
+                 prefill_interleave: int = 1):
         self.model = model
         self.plan = kv_cache.build_plan(model)
         self.max_len = int(max_len or self.plan.max_position)
@@ -197,6 +218,23 @@ class ServeEngine:
             raise ValueError(
                 f"max_len {self.max_len} exceeds the model's positional "
                 f"table ({self.plan.max_position})")
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefill_interleave = int(prefill_interleave)
+        if self.prefill_chunk:
+            if (self.prefill_chunk < _MIN_PROMPT_PAD
+                    or self.prefill_chunk & (self.prefill_chunk - 1)):
+                raise ValueError(
+                    f"prefill_chunk must be a power of two >= "
+                    f"{_MIN_PROMPT_PAD}, got {prefill_chunk}")
+            if not paged and self.max_len % self.prefill_chunk:
+                raise ValueError(
+                    f"prefill_chunk {self.prefill_chunk} must divide "
+                    f"max_len {self.max_len} on the contiguous path — "
+                    "chunk K/V writes are dynamic_update_slice windows "
+                    "that must never run past the cache row")
+        if self.prefill_interleave < 1:
+            raise ValueError(
+                f"prefill_interleave must be >= 1, got {prefill_interleave}")
         self.max_batch = int(max_batch)
         self.temperature = float(temperature)
         self.clock = clock or time.monotonic
@@ -283,6 +321,10 @@ class ServeEngine:
                                 donate_argnums=(0,) if donate else ())
         self._paged_decode_fns: dict[int, callable] = {}
         self._paged_prefill_fns: dict[int, callable] = {}
+        #: Contiguous chunked-prefill programs, one per pow2 chunk pad.
+        #: (The paged chunked path reuses _paged_prefill_fns — the paged
+        #: prefill kernel already takes a traced window start.)
+        self._chunk_fns: dict[int, callable] = {}
         self._copy_fn = jax.jit(kv_cache.copy_page,
                                 donate_argnums=(0,) if donate else ())
 
@@ -454,17 +496,35 @@ class ServeEngine:
             self._paged_prefill_fns[pad_len] = fn
         return fn
 
+    def _chunk_fn(self, pad_len: int):
+        fn = self._chunk_fns.get(pad_len)
+        if fn is None:
+            fn = self._acquire_program(
+                "prefill_chunk", pad_len,
+                lambda: jax.jit(
+                    functools.partial(kv_cache.prefill_chunk_step,
+                                      self.plan),
+                    donate_argnums=self._donate))
+            self._chunk_fns[pad_len] = fn
+        return fn
+
     def compiled_programs(self) -> dict:
         """{'decode': [buckets...], 'prefill': [pad_lens...]} — tests pin
         the no-retrace property on this. Paged engines report their
         ``paged_decode``/``paged_prefill`` surfaces too (a suffix prefill
         after a prefix hit pads to a smaller power of two, so warm and
-        cold prefills land in different — but both steady — programs)."""
+        cold prefills land in different — but both steady — programs).
+        Contiguous chunked engines add ``prefill_chunk``: one program per
+        pow2 chunk pad (paged chunked engines run chunks through the
+        ``paged_prefill`` surface — same traced-start programs). The
+        default ``prefill_chunk=0`` leaves the dict bit-unchanged."""
         out = {"decode": sorted(self._decode_fns),
                "prefill": sorted(self._prefill_fns)}
         if self.paged:
             out["paged_decode"] = sorted(self._paged_decode_fns)
             out["paged_prefill"] = sorted(self._paged_prefill_fns)
+        if self.prefill_chunk and not self.paged:
+            out["prefill_chunk"] = sorted(self._chunk_fns)
         return out
 
     # -- request intake -------------------------------------------------------
@@ -578,7 +638,11 @@ class ServeEngine:
         on the free list). Must run BEFORE the mirrored slot swap, while
         the allocator row still belongs to this request."""
         if self.paged and req.released_slot is not None:
-            self._paging.finish(req.released_slot, req.prompt)
+            # Bound prefix registration to positions actually written: a
+            # request evicted mid-chunked-prefill holds allocated pages
+            # past its cursor whose K/V are garbage.
+            upto = min(req.prefill_pos, len(req.prompt))
+            self._paging.finish(req.released_slot, req.prompt, upto=upto)
             req.released_slot = None
 
     def _retire(self, req: Request, *, now: float, status: str) -> None:
@@ -618,6 +682,12 @@ class ServeEngine:
         # it had already generated: the incremental-decode ≡ full-forward
         # equivalence makes the greedy continuation token-identical to an
         # uninterrupted run (req.generated is empty on the normal path).
+        # Under chunked prefill, the same holds because recovery re-admits
+        # through THIS dispatch: the replayed sequence re-prefills through
+        # the identical chunked path.
+        if self.prefill_chunk:
+            self._begin_chunked_prefill(req)
+            return
         seq = list(req.prompt) + list(req.generated)
         plen = len(seq)
         if self.paged:
@@ -645,15 +715,90 @@ class ServeEngine:
             self.cache, logits = fn(self.params, self.cache,
                                     jnp.asarray(tokens), jnp.int32(plen),
                                     jnp.int32(req.slot))
+        req.prefill_pos = plen
         metrics.inc("serve.prefills")
-        now = self.clock()
+        # Materialize BEFORE stamping first-token time: jax dispatch is
+        # async, so the pre-readback clock() under-reported TTFT against
+        # any client-observed wall clock (the PR 12 wart).
         token = self._pick(np.asarray(logits))
+        now = self.clock()
         done = self.scheduler.record_token(req, token, now=now)
         metrics.inc("serve.tokens.generated")
         if self.journal is not None:
             self.journal.record_token(req.rid, token)
         self._tokens[req.slot] = token
         self._lengths[req.slot] = plen
+        if done or plen >= self.max_len:
+            self._retire(req, now=now, status=DONE)
+
+    def _begin_chunked_prefill(self, req: Request) -> None:
+        """Admission under ``prefill_chunk > 0``: set up the slot (page
+        table + prefix-cache attach in paged mode — allocation is
+        chunk-granular from here on) and put the request on the chunk
+        queue. No forward pass runs yet; :meth:`step` drains chunks
+        interleaved with decode."""
+        seq = list(req.prompt) + list(req.generated)
+        if self.paged:
+            setup = self._paging.begin(req.slot, seq,
+                                       self._total_tokens(req),
+                                       chunk=self.prefill_chunk)
+            for src, dst in setup.copies:
+                self.cache = self._copy_fn(self.cache, jnp.int32(src),
+                                           jnp.int32(dst))
+            req.prefill_pos = setup.start
+        else:
+            req.prefill_pos = 0
+        # Mirror the cursor: a mid-prefill slot rides inside the decode
+        # bucket, so decode scatters one garbage K/V write at exactly
+        # lengths[slot] — the next unwritten position, which the next
+        # chunk (or, on the final chunk's completion, a real append)
+        # overwrites before any validity mask admits it.
+        self._tokens[req.slot] = 0
+        self._lengths[req.slot] = req.prefill_pos
+        self.scheduler.enqueue_prefill(req)
+
+    def _prefill_chunk_one(self, req: Request) -> None:
+        """Run ONE chunk of ``req``'s prefill: positions
+        ``[prefill_pos, min(prefill_pos + prefill_chunk, plen))``. The
+        final chunk yields the last valid position's logits — the first
+        generated token — and moves the request into the decode set."""
+        seq = list(req.prompt) + list(req.generated)
+        plen = len(seq)
+        startpos = req.prefill_pos
+        end = min(startpos + self.prefill_chunk, plen)
+        valid = end - startpos
+        pad = _pad_to_pow2(valid, hi=self.prefill_chunk)
+        tokens = np.zeros(pad, np.int32)
+        tokens[:valid] = seq[startpos:end]
+        if self.paged:
+            self._paging.extend_prefill(req.slot, end)
+            fn = self._paged_prefill_fn(pad)
+            row = self._paging.allocator.table[req.slot]
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(row), jnp.asarray(tokens),
+                                    jnp.int32(end), jnp.int32(startpos))
+        else:
+            fn = self._chunk_fn(pad)
+            self.cache, logits = fn(self.params, self.cache,
+                                    jnp.asarray(tokens), jnp.int32(end),
+                                    jnp.int32(req.slot),
+                                    jnp.int32(startpos))
+        req.prefill_pos = end
+        self._lengths[req.slot] = end
+        metrics.inc("serve.prefill.chunks")
+        if end < plen:
+            return  # more chunks owed; logits of a mid-chunk are unused
+        self.scheduler.dequeue_prefill(req)
+        if self.paged:
+            self._paging.register_prefill(req.slot, req.prompt)
+        metrics.inc("serve.prefills")
+        token = self._pick(np.asarray(logits))  # readback, then stamp
+        now = self.clock()
+        done = self.scheduler.record_token(req, token, now=now)
+        metrics.inc("serve.tokens.generated")
+        if self.journal is not None:
+            self.journal.record_token(req.rid, token)
+        self._tokens[req.slot] = token
         if done or plen >= self.max_len:
             self._retire(req, now=now, status=DONE)
 
@@ -681,6 +826,16 @@ class ServeEngine:
             self._prefill(req)
         metrics.set_gauge("serve.queue.depth", self.scheduler.queue_depth())
 
+        if self.prefill_chunk:
+            # Interleave policy: at most ``prefill_interleave`` prefill
+            # chunks between consecutive decode steps, drained
+            # arrival-ordered from the head of the chunk queue.
+            for _ in range(self.prefill_interleave):
+                head = self.scheduler.peek_prefill()
+                if head is None:
+                    break
+                self._prefill_chunk_one(head)
+
         n = self.scheduler.num_active
         if self.paged:
             self._paging.note_usage()
@@ -688,14 +843,22 @@ class ServeEngine:
             if self.journal is not None:
                 self.journal.flush()
             return 0
+        # Decode covers only fully-prefilled slots; a mid-chunk slot's
+        # cursor excludes it until its last chunk lands (ready() is all
+        # of active() when chunking is off).
+        ready = self.scheduler.ready()
+        if not ready:
+            if self.journal is not None:
+                self.journal.flush()
+            return n
         bucket = self.scheduler.bucket()
-        metrics.observe_value("serve.batch.occupancy", n / bucket)
+        metrics.observe_value("serve.batch.occupancy", len(ready) / bucket)
         if self.paged:
             # Host-side page bookkeeping for this round's appends: cross
             # a page boundary -> allocate the next page (covered by the
             # admission reservation); tail page shared with the prefix
             # cache -> copy-on-write it private before the scatter.
-            for req in self.scheduler.active():
+            for req in ready:
                 for src, dst in self._paging.prepare_append(
                         req.slot, int(self._lengths[req.slot])):
                     self.cache = self._copy_fn(self.cache, jnp.int32(src),
@@ -737,7 +900,7 @@ class ServeEngine:
                                 + (1.0 - _EMA_ALPHA) * self._step_ema_s)
         now = self.clock()
         completed = []
-        for req in self.scheduler.active():
+        for req in ready:
             token = self._pick(logits[req.slot])
             self._lengths[req.slot] += 1
             self._tokens[req.slot] = token

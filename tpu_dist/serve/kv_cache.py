@@ -277,6 +277,92 @@ def prefill(plan: DecodePlan, params, cache: dict, tokens, length, slot,
     return cache, last[0, 0]
 
 
+def prefill_chunk_step(plan: DecodePlan, params, cache: dict, tokens,
+                       length, slot, start):
+    """Causal forward over ONE chunk of a prompt — the contiguous-cache
+    half of chunked prefill.
+
+    The first ``start`` positions' K/V are already in cache slot
+    ``slot`` (written by earlier chunks); this pass computes positions
+    ``start .. length - 1``, writes their K/V at a traced window offset
+    via ``dynamic_update_slice``, and attends each chunk query over the
+    whole cached row under the absolute-position causal mask — exactly
+    what a full prefill would compute for those positions, so chunked
+    and whole-prompt prefill stay token-identical (the paged path gets
+    the same semantics for free from :func:`paged_prefill`'s traced
+    ``start``).
+
+    Args:
+      tokens: int32 ``[chunk_pad]`` — chunk tokens for absolute
+        positions ``start .. length - 1``, padded past
+        ``length - start``. Padded positions write garbage K/V at
+        ``[length, start + chunk_pad)``; positions there are beyond
+        every mask until a later chunk or decode append overwrites them
+        (the same argument that covers whole-prompt prefill padding).
+        The caller must guarantee ``start + chunk_pad <= max_len`` —
+        ``dynamic_update_slice`` would otherwise clamp the window start
+        and silently corrupt earlier positions.
+      length: scalar int32 total valid positions through the end of
+        this chunk (prefix + chunk).
+      slot: scalar int32 cache row.
+      start: scalar int32 already-cached positions (``< length``).
+
+    Returns:
+      ``(cache, last_logits)`` — logits ``[vocab]`` of position
+      ``length - 1`` (the first-generated-token distribution when this
+      is the final chunk; intermediate chunks' logits are discarded).
+    """
+    pad = tokens.shape[0]
+    x = tokens[None]                       # [1, pad]
+    valid = length - start
+    pos = start + jnp.arange(pad)          # absolute positions [pad]
+    max_len = cache["k"].shape[3]
+    key_pos = jnp.arange(max_len)
+    residuals: list = []
+    for op in plan.ops:
+        tag = op[0]
+        if tag == "res_start":
+            residuals.append(x)
+        elif tag == "res_end":
+            x = _activation(op[1])(residuals.pop() + x)
+        elif tag == "pos":
+            _, layer, path = op
+            table = _params_at(params, path)["table"]
+            at = jnp.minimum(pos, table.shape[0] - 1)
+            x = x + table[at].astype(x.dtype)[None]
+        elif tag == "attn":
+            _, layer, path, idx = op
+            p = _params_at(params, path)
+            q, k, v = _qkv(layer, p, x)    # [1, H, pad, dk]
+            dt = cache["k"].dtype
+            # Window write at the traced chunk offset, then attend over
+            # the whole row (earlier chunks' K/V plus this one's).
+            for name, new in (("k", k), ("v", v)):
+                cache[name] = jax.lax.dynamic_update_slice(
+                    cache[name], new.astype(dt)[None],
+                    (idx, slot, 0, start, 0))
+            keys = jnp.take(cache["k"][idx], slot, axis=0)  # [H, S, dk]
+            vals = jnp.take(cache["v"][idx], slot, axis=0)
+            scale = 1.0 / math.sqrt(layer.key_dim)
+            s = jnp.einsum("hqd,hkd->hqk", q[0].astype(jnp.float32),
+                           keys.astype(jnp.float32)) * scale
+            # Key j is position j: <= the query's own absolute position
+            # covers causality and prefix validity in one mask.
+            mask = key_pos[None, :] <= pos[:, None]         # [pad, S]
+            s = jnp.where(mask[None], s, -jnp.inf)
+            prob = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("hqk,hkd->hqd", prob,
+                             vals.astype(jnp.float32))
+            x = _attn_out(layer, p, out.astype(q.dtype)[None])
+        else:  # "embed" / "point"
+            _, layer, path = op
+            x, _ = layer.apply(_params_at(params, path), {}, x)
+    # x: [1, pad, vocab]; last valid chunk position is valid - 1.
+    last = jax.lax.dynamic_slice(
+        x, (0, jnp.maximum(valid - 1, 0), 0), (1, 1, plan.vocab_size))
+    return cache, last[0, 0]
+
+
 # -- incremental decode -------------------------------------------------------
 
 

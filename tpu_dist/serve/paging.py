@@ -477,10 +477,19 @@ class PagedKVState:
 
     # -- prefill --------------------------------------------------------------
 
-    def begin(self, slot: int, seq, total_tokens: int) -> PrefillSetup:
+    def begin(self, slot: int, seq, total_tokens: int, *,
+              chunk: int = 0) -> PrefillSetup:
         """Build ``slot``'s page table for prefilling ``seq``: bind the
         admission reservation, attach any cached prefix (copy-on-write
         on a partial tail), and allocate private pages for the suffix.
+
+        ``chunk > 0`` switches to chunk-granular allocation: only the
+        pages the FIRST chunk (positions ``[start, start + chunk)``)
+        writes are allocated now; :meth:`extend_prefill` draws the rest
+        from the admission reservation one chunk at a time, so a
+        half-prefilled long prompt pins pages proportional to its
+        progress, not its full length. Prefix-cache hits skip whole
+        cached chunks — the suffix starts at ``start``.
         """
         alloc = self.allocator
         ps = alloc.page_size
@@ -512,11 +521,23 @@ class PagedKVState:
                 metrics.inc("serve.prefix.misses")
             metrics.observe_value("serve.prefill.skipped_tokens",
                                   float(matched))
-        # Private pages for every position the suffix will write.
-        last_page = (len(seq) - 1) // ps
+        # Private pages for every position the suffix will write — the
+        # whole suffix up front, or just the first chunk's worth.
+        upto = len(seq) if chunk <= 0 else min(start + chunk, len(seq))
+        last_page = (upto - 1) // ps
         while alloc.count[slot] <= last_page:
             alloc.alloc(slot)
         return PrefillSetup(start=start, copies=copies)
+
+    def extend_prefill(self, slot: int, upto: int) -> None:
+        """Chunk-granular growth: allocate pages so positions
+        ``[0, upto)`` all have a table entry. Draws from the admission
+        reservation bound in :meth:`begin`, so it cannot deadlock
+        against other requests."""
+        alloc = self.allocator
+        last_page = (int(upto) - 1) // alloc.page_size
+        while alloc.count[slot] <= last_page:
+            alloc.alloc(slot)
 
     def register_prefill(self, slot: int, prompt) -> None:
         """Index the prompt's full pages right after prefill wrote them,
@@ -542,14 +563,24 @@ class PagedKVState:
 
     # -- finish / swap --------------------------------------------------------
 
-    def finish(self, slot: int, prompt) -> None:
+    def finish(self, slot: int, prompt, *,
+               upto: Optional[int] = None) -> None:
         """Release the slot's pages; first index the prompt's tail chunk
         (and any full chunks a recovery prefill skipped registering) so
-        the next identical prompt hits."""
+        the next identical prompt hits.
+
+        ``upto`` bounds registration to prompt positions whose K/V were
+        actually WRITTEN — a request evicted mid-chunked-prefill may
+        hold allocated-but-unwritten pages, and registering those would
+        poison the prefix cache with garbage K/V. The partial tail is
+        only indexed when the whole prompt landed."""
         if self.prefix is not None:
+            n = len(prompt) if upto is None else min(int(upto), len(prompt))
             self.prefix.register_full(prompt, self.allocator.table[slot],
-                                      upto=len(prompt))
-            self.prefix.register_partial(prompt, self.allocator.table[slot])
+                                      upto=n)
+            if n == len(prompt):
+                self.prefix.register_partial(prompt,
+                                             self.allocator.table[slot])
         self.allocator.release_slot(slot)
 
     def swap_slots(self, i: int, j: int) -> None:

@@ -68,6 +68,14 @@ class Request:
     #: engine reads this to free the right page-table row; None until
     #: the request has held — and left — a slot.
     released_slot: Optional[int] = None
+    #: Chunked-prefill cursor: sequence positions whose K/V already sit
+    #: in the cache (prefix-cache hits included). While the request is
+    #: on the prefill queue this trails the prompt length and the slot
+    #: is excluded from decode; the whole-prompt path sets it to the
+    #: full prefilled length in one go. The engine also reads it at
+    #: release time to bound prefix-cache registration to pages that
+    #: were actually written.
+    prefill_pos: int = 0
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -121,6 +129,14 @@ class Scheduler:
         self.queue: list[Request] = []  #: FIFO, arrival order
         #: active requests by slot; slots [0, num_active) are occupied.
         self.slots: list[Optional[Request]] = [None] * max_batch
+        #: Chunked-prefill queue, arrival order: ACTIVE requests whose
+        #: prompts are still being prefilled chunk-by-chunk. The engine
+        #: drains the HEAD first (at most ``prefill_interleave`` chunks
+        #: between decode steps), so chunk draining is arrival-ordered
+        #: and starvation-free — a later long prompt cannot delay an
+        #: earlier one's first token. Empty unless the engine runs with
+        #: ``prefill_chunk > 0``.
+        self.prefilling: list[Request] = []
         self.num_active = 0
         self._cohort = 0  #: static policy: admitted cohort size, sticky
         self._next_rid = 0
@@ -188,6 +204,36 @@ class Scheduler:
             self._cohort = self.num_active
         return admitted
 
+    # -- chunked prefill queue ------------------------------------------------
+
+    def enqueue_prefill(self, req: Request) -> None:
+        """Put an admitted request on the chunk queue: its prompt will be
+        prefilled ``prefill_chunk`` positions at a time, interleaved with
+        decode steps, and its slot stays out of decode until the final
+        chunk lands."""
+        self.prefilling.append(req)
+
+    def peek_prefill(self) -> Optional[Request]:
+        """Arrival-order head of the chunk queue (None when empty)."""
+        return self.prefilling[0] if self.prefilling else None
+
+    def dequeue_prefill(self, req: Request) -> None:
+        """Drop a request from the chunk queue — its final chunk landed,
+        or it was evicted mid-prefill."""
+        self.prefilling = [r for r in self.prefilling if r is not req]
+
+    def is_prefilling(self, req: Request) -> bool:
+        return any(r is req for r in self.prefilling)
+
+    def ready(self) -> list[Request]:
+        """Active requests eligible for decode: everyone whose prefill is
+        complete. Identical to :meth:`active` when chunked prefill is
+        off (the queue is empty)."""
+        if not self.prefilling:
+            return self.active()
+        return [r for r in self.slots[:self.num_active]
+                if not self.is_prefilling(r)]
+
     # -- step accounting ------------------------------------------------------
 
     def active(self) -> list[Request]:
@@ -233,6 +279,8 @@ class Scheduler:
         slot = req.slot
         if not (0 <= slot < self.num_active and self.slots[slot] is req):
             raise ValueError(f"request {req.rid} does not own slot {slot}")
+        if self.prefilling:  # evicted mid-prefill: off the chunk queue too
+            self.dequeue_prefill(req)
         req.status = status
         req.finish_s = now
         req.released_slot = slot
