@@ -2,7 +2,8 @@
 # Repo gate: shardcheck static analysis, the resilience smoke chaos run,
 # the elastic preempt+reshape chaos run, the observe telemetry smoke/bench,
 # the checkpoint stall bench, the serve load bench, the step-execution
-# overlap bench, then the tier-1 test suite.
+# overlap bench, the concurrency/liveness analysis, then the tier-1 test
+# suite.
 #
 # Usage: scripts/check.sh
 #
@@ -220,6 +221,26 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   -p no:cacheprovider >/dev/null \
   || { echo "check.sh: multichip chaos smoke failed" >&2
        exit 1; }
+
+echo "== analysis-concurrency: host-runtime thread-safety & liveness =="
+# Pure-AST interprocedural pass (no jax backend, no trace): SC4xx
+# thread-safety + SC5xx liveness/protocol rules over the host runtime,
+# plus SC901 stale-suppression policing. Strict (warnings fatal), github
+# annotation format for CI surfacing. Budget-gated: the whole pass must
+# stay under 30 s wall clock so it can run on every push — if it blows
+# the budget the analyzer grew an accidental quadratic, fail loudly.
+conc_start=$(date +%s)
+python -m tpu_dist.analysis --concurrency tpu_dist/ examples/ \
+  --strict --format github \
+  || { echo "check.sh: concurrency/liveness findings above" \
+       "(fix, or suppress on the finding line with a rationale)" >&2
+       exit 1; }
+conc_elapsed=$(( $(date +%s) - conc_start ))
+if [ "$conc_elapsed" -gt 30 ]; then
+  echo "check.sh: analysis-concurrency took ${conc_elapsed}s" \
+    "(budget: 30s)" >&2
+  exit 1
+fi
 
 echo "== tier-1 tests =="
 rm -f /tmp/_t1.log

@@ -43,6 +43,21 @@ GOOD_AST = ["declared_axis.py", "matching_spec.py", "pure_jit.py",
             "metrics_in_callback.py", "donate_rebind.py",
             "reraised_liveness.py"]
 
+#: Concurrency/liveness fixtures (``--concurrency`` mode): file -> exactly
+#: the rule IDs it must trip. Per-rule assertions live in
+#: test_shardcheck_concurrency.py; this map feeds the advertised-rule
+#: coverage sweep below.
+BAD_CONCURRENCY = {
+    "thread_unlocked_write.py": {"SC401"},
+    "blocking_join_under_lock.py": {"SC402"},
+    "collective_on_thread.py": {"SC403"},
+    "exit_under_lock.py": {"SC404"},
+    "rank_divergent_barrier.py": {"SC501"},
+    "unbounded_wait.py": {"SC502"},
+    "torn_protocol_write.py": {"SC503"},
+    "stale_suppression.py": {"SC901"},
+}
+
 
 def _cli_json(capsys, argv):
     """Run the CLI in-process with --json; return (exit_code, payload)."""
@@ -233,12 +248,21 @@ class TestCliContract:
             rc = cost_main(COST_FIXTURE_ARGS + [
                 "--baseline", str(BASELINES / baseline), "--json"])
             flagged |= _rule_ids(json.loads(capsys.readouterr().out))
+        # SC4xx/SC5xx/SC901 flag from the concurrency fixture set.
+        for name in BAD_CONCURRENCY:
+            _, payload = _cli_json(
+                capsys, [str(BAD / name), "--concurrency"])
+            flagged |= _rule_ids(payload)
         # SC900 is the degradation rule; its flagging fixture is synthetic
         # (test_unparseable_file_degrades_to_sc900) to keep bad/ all-error.
         assert advertised - {"SC900"} <= flagged
         # Every good fixture is clean of every rule, trace pass included
         # (--strict so warnings would fail too).
         rc, payload = _cli_json(capsys, [str(GOOD), "--strict"])
+        assert rc == 0
+        assert payload["findings"] == []
+        rc, payload = _cli_json(capsys, [str(GOOD), "--concurrency",
+                                         "--strict"])
         assert rc == 0
         assert payload["findings"] == []
         rc = cost_main(COST_FIXTURE_ARGS + [

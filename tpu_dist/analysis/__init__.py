@@ -18,11 +18,21 @@ TPU-hour is spent:
   — the static communication-volume and peak-HBM model over the same
   traces, and the committed-baseline diff behind SC301/SC302
   (``ANALYSIS_BASELINE.json``, the ``analysis-cost`` CI stage);
+* :mod:`~tpu_dist.analysis.concurrency` /
+  :mod:`~tpu_dist.analysis.liveness` — the host-runtime pass behind
+  ``--concurrency``: an interprocedural call graph plus thread-entry map
+  (Thread/Timer targets, signal handlers, Thread-subclass ``run``) and a
+  lexical lockset, feeding thread-safety rules SC401-SC404 (unlocked
+  shared attribute, blocking under lock, collective on a worker thread,
+  hard exit under lock) and liveness/protocol rules SC501-SC503
+  (rank-divergent barrier, unbounded blocking wait, torn protocol-file
+  write); the ``analysis-concurrency`` CI stage runs it strict;
 * :mod:`~tpu_dist.analysis.rules` / :mod:`~tpu_dist.analysis.report` —
-  the rule catalogue, suppressions, text/JSON/GitHub-annotation output,
-  exit-code policy;
-* :mod:`~tpu_dist.analysis.cli` — ``python -m tpu_dist.analysis [paths]``
-  and ``python -m tpu_dist.analysis cost``.
+  the rule catalogue, suppressions and their SC901 staleness policing,
+  text/JSON/GitHub-annotation output, exit-code policy;
+* :mod:`~tpu_dist.analysis.cli` — ``python -m tpu_dist.analysis [paths]``,
+  ``python -m tpu_dist.analysis --concurrency [paths]`` and
+  ``python -m tpu_dist.analysis cost``.
 
 See README.md "Static analysis" for the CLI and rule catalogue;
 ``scripts/check.sh`` wires the checker and the cost gate in front of the

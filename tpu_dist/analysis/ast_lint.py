@@ -26,7 +26,9 @@ mistake classes that compile fine and fail only on the machine:
 The pass is deliberately conservative: an axis name or array rank it
 cannot resolve statically is skipped, never guessed. Findings carry rule
 IDs from :mod:`tpu_dist.analysis.rules`; inline suppressions
-(``# shardcheck: disable=SC101  -- why``) are honored per line.
+(``# shardcheck: disable=<rule> -- why``) are honored per line.
+(The placeholder keeps this docstring from reading as a live
+suppression itself — SC901 polices those.)
 """
 
 from __future__ import annotations
@@ -563,22 +565,40 @@ def iter_python_files(paths: Iterable[str]) -> list[str]:
     return sorted(dict.fromkeys(out))
 
 
-def lint_file(path: str) -> list[Finding]:
-    """Lint one file; honors inline suppressions. Syntax errors come back
-    as an SC900 info finding rather than crashing the whole run."""
+def lint_file_raw(path: str):
+    """``(pre-suppression findings, source lines)`` for one file — the
+    feed for both suppression application and SC901 staleness. Syntax
+    errors come back as an SC900 info finding rather than crashing the
+    whole run."""
     with open(path, "r", encoding="utf-8") as fh:
         source = fh.read()
+    lines = source.splitlines()
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as e:
         return [Finding("SC900", path, e.lineno or 1, e.offset or 0,
-                        f"file does not parse: {e.msg}")]
-    findings = _FileLint(path, tree, source).run()
-    return apply_suppressions(findings, {path: source.splitlines()})
+                        f"file does not parse: {e.msg}")], lines
+    return _FileLint(path, tree, source).run(), lines
+
+
+def lint_file(path: str) -> list[Finding]:
+    """Lint one file; honors inline suppressions."""
+    findings, lines = lint_file_raw(path)
+    return apply_suppressions(findings, {path: lines})
+
+
+def lint_paths_raw(paths: Iterable[str]):
+    """``(pre-suppression findings, {path: source lines})`` over every
+    .py file under ``paths``."""
+    findings: list[Finding] = []
+    source_by_path: dict[str, list] = {}
+    for path in iter_python_files(paths):
+        file_findings, lines = lint_file_raw(path)
+        findings.extend(file_findings)
+        source_by_path[path] = lines
+    return findings, source_by_path
 
 
 def lint_paths(paths: Iterable[str]) -> list[Finding]:
-    findings: list[Finding] = []
-    for path in iter_python_files(paths):
-        findings.extend(lint_file(path))
-    return findings
+    findings, source_by_path = lint_paths_raw(paths)
+    return apply_suppressions(findings, source_by_path)

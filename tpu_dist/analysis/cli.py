@@ -34,7 +34,19 @@ import sys
 from typing import Optional
 
 from tpu_dist.analysis import ast_lint, report
-from tpu_dist.analysis.rules import Finding, apply_suppressions
+from tpu_dist.analysis.rules import (
+    Finding,
+    apply_suppressions,
+    stale_suppressions,
+)
+
+#: Which rules each mode evaluates — the SC901 staleness scope. SC2xx/
+#: SC3xx are excluded on purpose: whether a trace/baseline finding
+#: exists depends on the environment, so their suppressions cannot be
+#: proven stale from a single run.
+_AST_RULE_IDS = frozenset({"SC101", "SC102", "SC103", "SC104", "SC105"})
+_CONCURRENCY_RULE_IDS = frozenset({
+    "SC401", "SC402", "SC403", "SC404", "SC501", "SC502", "SC503"})
 
 
 def _force_cpu_backend() -> None:
@@ -120,6 +132,24 @@ def _render(findings, *, fmt: str, paths=(), fail_on: str) -> None:
         report.render_text(findings, paths=paths)
 
 
+def _concurrency_check(paths) -> list[Finding]:
+    """``--concurrency`` mode: SC4xx thread-safety + SC5xx liveness over
+    the interprocedural host call graph, then SC901 staleness for the
+    suppressions those rules own. Pure AST — no imports, no backend."""
+    from tpu_dist.analysis import concurrency, liveness
+
+    project = concurrency.build_project(paths)
+    raw = concurrency.check_project(project)
+    raw.extend(liveness.check_project(project))
+    source_by_path = {m.path: m.source_lines
+                      for m in project.modules.values()}
+    findings = apply_suppressions(raw, source_by_path)
+    findings.extend(apply_suppressions(
+        stale_suppressions(raw, source_by_path, _CONCURRENCY_RULE_IDS),
+        source_by_path))
+    return findings
+
+
 def main(argv: Optional[list] = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -148,6 +178,11 @@ def main(argv: Optional[list] = None) -> int:
         help="skip the jaxpr-level checks (AST lint only; no jax backend "
              "touched)")
     parser.add_argument(
+        "--concurrency", action="store_true",
+        help="run the host-runtime concurrency/liveness analyzer "
+             "(SC4xx/SC5xx + SC901) instead of the sharding lint; pure "
+             "AST, no backend")
+    parser.add_argument(
         "--fail-on", default="error",
         choices=("error", "warning", "info", "never"),
         help="lowest severity that makes the exit code non-zero "
@@ -172,7 +207,16 @@ def main(argv: Optional[list] = None) -> int:
         if not os.path.exists(p):
             parser.error(f"no such path: {p}")
 
-    findings = ast_lint.lint_paths(paths)
+    if args.concurrency:
+        findings = _concurrency_check(paths)
+        _render(findings, fmt=fmt, paths=paths, fail_on=fail_on)
+        return report.exit_code(findings, fail_on=fail_on)
+
+    raw, source_by_path = ast_lint.lint_paths_raw(paths)
+    findings = apply_suppressions(raw, source_by_path)
+    findings.extend(apply_suppressions(
+        stale_suppressions(raw, source_by_path, _AST_RULE_IDS),
+        source_by_path))
 
     if not args.no_trace:
         _force_cpu_backend()

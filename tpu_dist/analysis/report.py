@@ -72,11 +72,22 @@ _GITHUB_LEVEL = {
 
 
 def _github_escape(text: str) -> str:
-    """Workflow-command data escaping: %, CR and LF are the only
-    characters the parser treats specially in the message position."""
+    """Workflow-command data escaping for the message position: %, CR
+    and LF per the spec, plus ``::`` — a message carrying a literal
+    ``::`` (SC4xx messages quote lock names and call chains) would
+    otherwise be split by parsers that scan for the command delimiter."""
     return (text.replace("%", "%25")
             .replace("\r", "%0D")
-            .replace("\n", "%0A"))
+            .replace("\n", "%0A")
+            .replace("::", "%3A%3A"))
+
+
+def _github_escape_property(text: str) -> str:
+    """Property-position escaping (file=...): the parser additionally
+    treats ``:`` and ``,`` as structure there."""
+    return (_github_escape(text)
+            .replace(":", "%3A")
+            .replace(",", "%2C"))
 
 
 def render_github(findings: Iterable[Finding], *, stream=None) -> None:
@@ -86,7 +97,8 @@ def render_github(findings: Iterable[Finding], *, stream=None) -> None:
     for f in sort_findings(findings):
         level = _GITHUB_LEVEL[f.severity]
         message = _github_escape(f"[{f.rule_id}] {f.message}")
-        print(f"::{level} file={f.path},line={f.line},col={f.col}::"
+        path = _github_escape_property(f.path)
+        print(f"::{level} file={path},line={f.line},col={f.col}::"
               f"{message}", file=stream)
 
 

@@ -49,7 +49,10 @@ class Rule:
 
 
 #: The advertised catalogue. SC1xx are AST rules (ast_lint.py); SC2xx are
-#: jaxpr-level rules (jaxpr_checks.py).
+#: jaxpr-level rules (jaxpr_checks.py); SC3xx are cost/baseline rules
+#: (costmodel.py/baseline.py); SC4xx are host-runtime thread-safety rules
+#: and SC5xx liveness/protocol rules (concurrency.py/liveness.py, the
+#: ``--concurrency`` mode); SC901 polices the suppressions themselves.
 RULES = {r.id: r for r in (
     Rule(
         "SC101", "unknown-collective-axis", Severity.ERROR,
@@ -131,6 +134,70 @@ RULES = {r.id: r for r in (
         "donate_argnums would alias them and halve that footprint. "
         "The jaxpr-proof deepening of SC104's AST guess."),
     Rule(
+        "SC401", "unlocked-shared-attribute", Severity.WARNING,
+        "An instance attribute is written both from a thread entry "
+        "(Thread/Timer target, signal handler) and from non-thread code "
+        "with no common lock held at either write (lockset approximation "
+        "over `with self._lock:` scopes). Writes racing from two threads "
+        "tear multi-step updates and publish half-built state; either "
+        "share a lock across both writers or confine the attribute to "
+        "one side and hand results over via a queue/join."),
+    Rule(
+        "SC402", "blocking-call-under-lock", Severity.ERROR,
+        "A blocking call (Thread.join, Queue.get without timeout, "
+        "Event.wait without timeout, barrier/rendezvous/collective) "
+        "issued while holding a lock. Any other thread that needs the "
+        "same lock to make progress — including the one being joined — "
+        "deadlocks the process. Release the lock first, or bound the "
+        "wait. (Condition.wait inside `with cond:` is exempt: wait "
+        "releases the condition's own lock.)"),
+    Rule(
+        "SC403", "collective-on-worker-thread", Severity.ERROR,
+        "A jax dispatch (device_put) or collective/barrier/rendezvous "
+        "call is reachable from a non-main thread entry. Collectives "
+        "rendezvous across ranks in launch order; issuing one from a "
+        "helper thread races the main thread's launches and deadlocks "
+        "or mismatches the pairing (the async-checkpoint writer-thread "
+        "rule, machine-checked). Keep collectives on the main thread "
+        "and hand the result to the worker."),
+    Rule(
+        "SC404", "hard-exit-under-lock", Severity.ERROR,
+        "os._exit reachable from a code path that holds a lock. _exit "
+        "skips atexit/finally teardown, so lock-protected state (a "
+        "half-written protocol file, an unpublished async save) is "
+        "abandoned in whatever state the holder left it; exit from "
+        "outside the critical section or use the supervised-exit path."),
+    Rule(
+        "SC501", "rank-divergent-barrier", Severity.ERROR,
+        "A rank-conditional branch (`if rank == 0` / process_index() / "
+        "chief checks) where one arm reaches a barrier/rendezvous/"
+        "collective the other arm cannot. The rank(s) taking the "
+        "barrier-free arm never show up at the rendezvous and every "
+        "other rank blocks until timeout. Hoist the barrier out of the "
+        "conditional, or make both arms join it."),
+    Rule(
+        "SC502", "unbounded-blocking-wait", Severity.WARNING,
+        "A wait/poll loop whose blocking calls carry no timeout and "
+        "whose body has no deadline or abort_check-style escape. If the "
+        "peer it waits on dies, the loop spins or blocks forever and "
+        "the rank hangs the gang; bound each wait or consult an abort "
+        "signal per iteration."),
+    Rule(
+        "SC503", "torn-protocol-write", Severity.ERROR,
+        "A write to a protocol/marker/manifest file not staged through "
+        "tmp + os.replace. Readers polling the path can observe a "
+        "truncated or half-written payload mid-write; write to a tmp "
+        "name in the same directory and os.replace it into place so "
+        "publication is atomic."),
+    Rule(
+        "SC901", "stale-suppression", Severity.WARNING,
+        "A `# shardcheck: disable=SCnnn` comment that suppresses "
+        "nothing: no finding for that rule is raised at that line by "
+        "the current pass. Stale suppressions rot into blanket "
+        "exemptions as code moves; delete the comment or re-point it "
+        "at the line that still needs it. Only rules the running mode "
+        "actually evaluates are judged."),
+    Rule(
         "SC900", "entry-point-untraceable", Severity.INFO,
         "A registered jaxpr-check entry point could not be traced in "
         "this environment; its collective-order check was skipped."),
@@ -201,3 +268,34 @@ def apply_suppressions(findings, source_by_path) -> list:
                 continue
         kept.append(f)
     return kept
+
+
+def stale_suppressions(pre_findings, source_by_path, evaluated) -> list:
+    """SC901: suppression comments that suppress nothing.
+
+    ``pre_findings`` must be the findings *before* apply_suppressions,
+    so a live suppression (one that is eating a real finding) can be
+    told apart from a stale one. Only rule IDs in ``evaluated`` — the
+    rules the current mode actually ran — are judged; a comment naming
+    a rule from another family is left alone (its finding may exist in
+    the other mode), and ``disable=all`` is never judged for the same
+    reason.
+    """
+    fired: dict = {}
+    for f in pre_findings:
+        fired.setdefault((f.path, f.line), set()).add(f.rule_id)
+    evaluated = set(evaluated)
+    out = []
+    for path in sorted(source_by_path):
+        for i, line in enumerate(source_by_path[path], 1):
+            sup = suppressions_for_line(line)
+            if not sup or "all" in sup:
+                continue
+            live = fired.get((path, i), set())
+            for rule_id in sorted(sup & (evaluated - live)):
+                out.append(Finding(
+                    "SC901", path, i, 0,
+                    f"suppression for {rule_id} matches no {rule_id} "
+                    f"finding at this line; delete the comment or "
+                    f"re-point it at the code that still needs it"))
+    return out

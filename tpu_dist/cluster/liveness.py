@@ -80,7 +80,10 @@ class _Prober:
         self._gen = 0  # worker generation; replaced workers stop touching state
 
     def _run(self, generation_queue, gen: int) -> None:
-        while True:
+        # Persistent daemon worker: blocking on the queue IS its idle
+        # state. Liveness is owed by the callers — probe() bounds every
+        # request with timeout_s and abandons wedged ones.
+        while True:  # shardcheck: disable=SC502 -- idle state of a daemon worker; probe() callers carry the timeout
             seq, fn = generation_queue.get()
             with self._cv:
                 if seq in self._abandoned:

@@ -140,7 +140,10 @@ def run_load(engine, workload: list[dict], *, clients: int = 0,
         width = max(1, clients or engine.max_batch)
 
     submitted = 0
-    while submitted < len(pending) or not engine.scheduler.idle():
+    # Not a peer wait: every iteration either submits, steps the local
+    # engine (which always makes decode progress), or naps until the next
+    # seeded arrival — the workload is finite so the loop drains.
+    while submitted < len(pending) or not engine.scheduler.idle():  # shardcheck: disable=SC502 -- local engine progress bounds the loop
         if arrivals is not None:
             elapsed = time.monotonic() - t0
             while (submitted < len(pending)

@@ -603,12 +603,15 @@ class AsyncCheckpointer:
 
     def _write_v1(self, snap, step: int) -> None:
         t0 = time.perf_counter()
+        # _last_path/_error are single-writer handoffs, not shared state:
+        # the writer owns them until _drain's join(), and the join is the
+        # happens-before edge for the main thread's read-and-reset.
         try:
-            self._last_path = _write_v1_checkpoint(
+            self._last_path = _write_v1_checkpoint(  # shardcheck: disable=SC401 -- handoff attr; _drain joins before touching it
                 self.directory, _flatten_local(snap), step=step,
                 max_to_keep=self.max_to_keep)
         except Exception as exc:  # delivered at the next commit point
-            self._error = exc
+            self._error = exc  # shardcheck: disable=SC401 -- handoff attr; _drain joins before touching it
         finally:
             metrics_lib.observe_value("checkpoint.write_s",
                                       time.perf_counter() - t0)
