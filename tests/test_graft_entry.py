@@ -57,8 +57,10 @@ def test_dryrun_multichip_direct_call_after_jax_init():
 @pytest.mark.parametrize("n,timeout", [
     (4, 600),
     # A quarter of BASELINE.md's 32-core story ran at n=8 since r1; the
-    # 16-device point holds the next doubling in the suite (r4).
-    (16, 900),
+    # 16-device point holds the next doubling in the suite (r4). It is
+    # ~19s of pure re-compile of the same three programs the n=4 point
+    # already pins, so it rides outside tier-1's 870s budget.
+    pytest.param(16, 900, marks=pytest.mark.slow),
 ])
 def test_dryrun_multichip_child_invocation(n, timeout):
     # Exactly what the re-exec runs: ``python __graft_entry__.py n`` with the

@@ -160,6 +160,35 @@ class TestThreadEntryBuilder:
                      for k in project.thread_reachable}
         assert "flush" in reachable
 
+    def test_lambda_for_parameter_target(self, tmp_path):
+        # regression: registering the caller's lambda mutated
+        # project.functions while _resolve_param was iterating it
+        project = _build(tmp_path, """\
+            import threading
+
+            def _spawn(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+
+            def work():
+                return 1
+
+            def begin():
+                _spawn(lambda: work())
+            """)
+        assert project.unresolved_spawns == []
+        reachable = {project.functions[k].name
+                     for k in project.thread_reachable}
+        assert "work" in reachable
+
+    def test_syntax_error_file_reported_sc900(self, tmp_path, capsys):
+        # ast_lint does not run in --concurrency mode, so the analyzer
+        # itself must report an unparsable file instead of dropping it.
+        f = tmp_path / "broken.py"
+        f.write_text("def oops(:\n")
+        rc, payload = _cli_json(capsys, [str(f), "--concurrency"])
+        assert "SC900" in _rule_ids(payload)
+
     def test_parameter_target_resolved_through_caller(self, tmp_path):
         project = _build(tmp_path, """\
             import threading
@@ -223,6 +252,51 @@ class TestThreadEntryBuilder:
         assert "SC900" in _rule_ids(payload)
 
 
+class TestUnboundedWaitForms:
+    """SC502 boundary forms: spelled-out blocking defaults
+    (``acquire(True)``, ``wait(None)``, ``get(True)``) still block
+    forever; real timeouts and non-blocking forms do not."""
+
+    SOURCE = """\
+        import queue
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition()
+                self._q = queue.Queue()
+                self._t = threading.Thread(target=self._spin, daemon=True)
+
+            def _spin(self):
+                while True:
+                    {call}
+        """
+
+    @pytest.mark.parametrize("call", [
+        "self._lock.acquire(True)",
+        "self._cond.wait(None)",
+        "self._q.get(True)",
+    ])
+    def test_spelled_out_defaults_are_unbounded(self, tmp_path, capsys,
+                                                call):
+        f = _write(tmp_path, self.SOURCE.format(call=call))
+        _rc, payload = _cli_json(capsys, [str(f), "--concurrency"])
+        assert "SC502" in _rule_ids(payload)
+
+    @pytest.mark.parametrize("call", [
+        "self._lock.acquire(True, 1.0)",
+        "self._lock.acquire(False)",
+        "self._cond.wait(0.5)",
+        "self._q.get(True, 0.5)",
+    ])
+    def test_bounded_or_nonblocking_forms_are_quiet(self, tmp_path,
+                                                    capsys, call):
+        f = _write(tmp_path, self.SOURCE.format(call=call))
+        _rc, payload = _cli_json(capsys, [str(f), "--concurrency"])
+        assert "SC502" not in _rule_ids(payload)
+
+
 class TestStaleSuppressions:
     def test_stale_suppression_fires_sc901(self):
         lines = ["x = 1  # shardcheck: disable=SC403 -- moved away"]
@@ -246,16 +320,20 @@ class TestStaleSuppressions:
 
 
 class TestGithubEscaping:
-    def test_message_newlines_and_delimiters_escaped(self):
+    def test_message_newlines_escaped_colons_preserved(self):
         buf = io.StringIO()
         render_github(
             [Finding("SC402", "a.py", 3, 1,
                      "blocking q.get() under lock::self._lock\nheld")],
             stream=buf)
         (line,) = buf.getvalue().splitlines()
-        assert line.count("::") == 2  # command prefix + data separator
-        assert "%0A" in line and "%3A%3A" in line
-        assert "\n" not in line.replace("\\n", "")
+        # The runner parses by the first two :: only and unescapes just
+        # %/CR/LF in the message, so a message-position :: must stay
+        # literal — %-encoding it would render verbatim in the annotation.
+        message = line.split("::", 2)[2]
+        assert message == ("[SC402] blocking q.get() under "
+                           "lock::self._lock%0Aheld")
+        assert "\n" not in line
 
     def test_path_colons_and_commas_escaped(self):
         buf = io.StringIO()

@@ -203,6 +203,8 @@ class Project:
         self.entries: dict[str, str] = {}
         #: (path, line, kind, expr text) for targets nobody could resolve.
         self.unresolved_spawns: list = []
+        #: (path, line, message) for files ast.parse rejected.
+        self.syntax_errors: list = []
         self.thread_reachable: set = set()
         #: reached key -> entry key it was first discovered from.
         self.entry_origin: dict = {}
@@ -318,8 +320,14 @@ def _register_module(project: Project, path: str) -> Optional[ModuleInfo]:
         with open(path, "r", encoding="utf-8") as fh:
             source = fh.read()
         tree = ast.parse(source, filename=path)
-    except (OSError, SyntaxError):
-        return None  # ast_lint already reports unparsable files (SC900)
+    except OSError:
+        return None
+    except SyntaxError as exc:
+        # surfaced as SC900 by check_project: in --concurrency mode
+        # ast_lint does not run, so this is the only report the file gets
+        project.syntax_errors.append((path, exc.lineno or 1,
+                                      exc.msg or "syntax error"))
+        return None
     mod = ModuleInfo(
         path=path, modname=module_name_for(path), tree=tree,
         aliases=_collect_aliases(tree), source_lines=source.splitlines())
@@ -723,7 +731,9 @@ def _resolve_param(project: Project, fn: FunctionInfo, name: str,
         return []
     pidx = positional.index(name) if name in positional else None
     out = []
-    for caller in project.functions.values():
+    # snapshot: _resolve_target registers lambda arguments as new
+    # FunctionInfo entries in project.functions while we iterate it
+    for caller in list(project.functions.values()):
         for key, _line, _col, _locks, call in caller.call_sites:
             if key != fn.key:
                 continue
@@ -830,9 +840,16 @@ def build_project(paths: Iterable[str]) -> Project:
 
 
 def check_project(project: Project) -> list:
-    """SC401-SC404 over a built project, plus SC900 causes for thread
-    targets the resolver could not pin down."""
+    """SC401-SC404 over a built project, plus SC900 causes for files
+    that failed to parse and thread targets the resolver could not pin
+    down."""
     findings: list[Finding] = []
+
+    for path, line, msg in project.syntax_errors:
+        findings.append(Finding(
+            "SC900", path, line, 0,
+            f"file could not be parsed ({msg}); excluded from the "
+            f"SC4xx/SC5xx analysis"))
 
     for path, line, kind, text in project.unresolved_spawns:
         findings.append(Finding(

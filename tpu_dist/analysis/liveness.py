@@ -194,9 +194,12 @@ def _wait_calls(node: ast.While):
         recv = (call.func.value if isinstance(call.func, ast.Attribute)
                 else None)
         if tail == "get":
-            if call.args:
+            first = call.args[0] if call.args else None
+            if first is not None and not (isinstance(first, ast.Constant)
+                                          and first.value is True):
                 continue  # dict.get(key)/environ.get(key): not a wait
-            yield call, timeout_kw
+            # q.get() / q.get(True) block; only a timeout bounds them
+            yield call, timeout_kw or len(call.args) > 1
         elif tail == "join":
             if call.args or timeout_kw:
                 continue  # "sep".join(parts) or a bounded join: ignore
@@ -204,7 +207,20 @@ def _wait_calls(node: ast.While):
                 continue  # literal-separator string join
             yield call, False
         elif tail in ("wait", "acquire"):
-            yield call, bool(call.args) or timeout_kw
+            bounded = timeout_kw
+            if call.args:
+                first = call.args[0]
+                if (isinstance(first, ast.Constant)
+                        and (first.value is None or first.value is True)):
+                    # cond.wait(None) / lock.acquire(True) spell out the
+                    # defaults and still block forever; a second arg is
+                    # acquire's timeout
+                    bounded = bounded or len(call.args) > 1
+                else:
+                    # a numeric first arg is a timeout; acquire(False)
+                    # never blocks
+                    bounded = True
+            yield call, bounded
         else:  # sleep: bounded per call, but it never bounds the loop
             yield call, False
 

@@ -73,13 +73,13 @@ _GITHUB_LEVEL = {
 
 def _github_escape(text: str) -> str:
     """Workflow-command data escaping for the message position: %, CR
-    and LF per the spec, plus ``::`` — a message carrying a literal
-    ``::`` (SC4xx messages quote lock names and call chains) would
-    otherwise be split by parsers that scan for the command delimiter."""
+    and LF per the spec. A literal ``::`` in the message (SC4xx messages
+    quote lock names and call chains) needs no escaping — the runner
+    splits on the first two ``::`` delimiters only, and it would render
+    any %-encoding we added verbatim."""
     return (text.replace("%", "%25")
             .replace("\r", "%0D")
-            .replace("\n", "%0A")
-            .replace("::", "%3A%3A"))
+            .replace("\n", "%0A"))
 
 
 def _github_escape_property(text: str) -> str:
