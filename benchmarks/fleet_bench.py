@@ -1,0 +1,188 @@
+"""Fleet benchmark: throughput scaling 1 -> 2 replicas at fixed p99.
+
+What it measures
+----------------
+The same sessioned backlog — ``--requests`` requests over
+``--sessions`` shared-prefix sessions, submitted up front — driven
+through :class:`ServeFleet` at 1 and 2 replicas.  Every replica engine
+gets its own :class:`VirtualClock` advanced ``--virtual-step-s`` per
+decode step, so makespans and latencies are deterministic functions of
+the *schedule* (decode rounds executed), not of host speed, thread
+interleaving, or the GIL: replicas decode independent batches, so fleet
+virtual makespan is the max over replica clocks and doubling the
+replica count should roughly halve it.
+
+Gates (exit 1 on failure)
+-------------------------
+* **scaling**: virtual throughput (requests / makespan) at 2 replicas
+  >= ``--min-scaling`` x the 1-replica fleet (default 1.8);
+* **fixed p99**: 2-replica virtual p99 request latency <=
+  ``--p99-frac`` x the 1-replica p99 (default 1.0 — adding a replica
+  must not cost tail latency; it should slash it);
+* **anti-vacuity**: the 2-replica run routed >= 1 request by prefix
+  affinity AND >= 1 by least-loaded fallback;
+* **token parity**: both fleet runs stream bit-identical to a solo
+  engine on the same workload (greedy decode is batch-composition
+  independent);
+* **no new programs**: the 1-replica fleet's ``compiled_programs()``
+  is bit-identical to the solo engine's — the router adds no device
+  programs.
+
+Writes ``BENCH_FLEET.json`` (see ``--out``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_dist.observe import metrics
+from tpu_dist.serve.chaos import VirtualClock
+from tpu_dist.serve.fleet import ServeFleet, _fleet_workload
+
+
+def _build_model(args):
+    from tpu_dist.models.transformer import build_transformer_lm
+    return build_transformer_lm(args.vocab, args.max_len,
+                                d_model=args.d_model, depth=args.depth,
+                                num_heads=args.num_heads)
+
+
+def _engine(model, args, *, clock, journal=None, fault_injector=None):
+    from tpu_dist.serve.engine import ServeEngine
+    return ServeEngine(model, max_batch=args.max_batch,
+                       max_len=args.max_len, seed=args.seed,
+                       clock=clock, virtual_step_s=args.virtual_step_s,
+                       journal=journal, fault_injector=fault_injector)
+
+
+def _p99(latencies) -> float:
+    lats = sorted(latencies)
+    return lats[min(len(lats) - 1, int(0.99 * len(lats)))]
+
+
+def _fleet_leg(model, args, workload, replicas: int) -> dict:
+    """One fleet run; virtual metrics from the per-replica clocks."""
+    clocks: dict = {}
+    clock_lock = threading.Lock()
+
+    def factory(replica, *, journal, fault_injector):
+        del journal  # journaling off: the bench measures steady state
+        clock = VirtualClock()
+        with clock_lock:
+            clocks[replica] = clock
+        return _engine(model, args, clock=clock,
+                       fault_injector=fault_injector)
+
+    fleet = ServeFleet(factory, replicas=replicas,
+                       page_size=args.page_size)
+    fleet.start()
+    frs = [fleet.submit(w["prompt"], max_new_tokens=w["max_new_tokens"])
+           for w in workload]
+    fleet.drain(timeout_s=args.deadline)
+    fleet.close()
+    makespan = max(c.t for c in clocks.values())
+    return {
+        "replicas": replicas,
+        "makespan_virtual_s": makespan,
+        "throughput_rps": len(frs) / makespan,
+        "p99_latency_s": _p99([fr.latency_s for fr in frs]),
+        "route": dict(fleet.route_counts),
+        "programs": fleet.compiled_programs(),
+        "tokens": [fr.tokens for fr in frs],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    # Backlog deep enough to amortize the low-occupancy drain tail (the
+    # last < max_batch requests decode the same number of rounds no
+    # matter how many replicas idle beside them).
+    p.add_argument("--requests", type=int, default=96)
+    p.add_argument("--sessions", type=int, default=6)
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-len", type=int, default=64)
+    p.add_argument("--min-new", type=int, default=2)
+    p.add_argument("--max-new", type=int, default=24)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--d-model", type=int, default=48)
+    p.add_argument("--depth", type=int, default=1)
+    p.add_argument("--num-heads", type=int, default=4)
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--virtual-step-s", type=float, default=0.05)
+    p.add_argument("--min-scaling", type=float, default=1.8,
+                   help="1->2 replica virtual-throughput gate")
+    p.add_argument("--p99-frac", type=float, default=1.0,
+                   help="2-replica p99 must be <= this x 1-replica p99")
+    p.add_argument("--deadline", type=float, default=300.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=str(pathlib.Path(__file__).parent.parent
+                                        / "BENCH_FLEET.json"))
+    args = p.parse_args(argv)
+
+    metrics.get_registry().reset()
+    metrics.enable()
+    model = _build_model(args)
+    workload = _fleet_workload(args, sessions=args.sessions,
+                               page_size=args.page_size)
+
+    # Uninterrupted solo ground truth: token streams + program surface.
+    print(f"fleet-bench: solo baseline — {len(workload)} requests, "
+          f"{args.sessions} sessions")
+    solo = _engine(model, args, clock=VirtualClock())
+    reqs = [solo.submit(w["prompt"], max_new_tokens=w["max_new_tokens"])
+            for w in workload]
+    solo.run_until_idle()
+    baseline = [list(r.generated) for r in reqs]
+    solo_programs = solo.compiled_programs()
+    solo.close()
+
+    legs = {}
+    for replicas in (1, 2):
+        print(f"fleet-bench: {replicas} replica(s)")
+        legs[replicas] = _fleet_leg(model, args, workload, replicas)
+
+    one, two = legs[1], legs[2]
+    scaling = two["throughput_rps"] / one["throughput_rps"]
+    gates = {
+        "scaling": scaling >= args.min_scaling,
+        "fixed_p99": two["p99_latency_s"]
+        <= args.p99_frac * one["p99_latency_s"],
+        "affinity_nonvacuous": two["route"]["affinity"] >= 1,
+        "fallback_nonvacuous": two["route"]["fallback"] >= 1,
+        "token_parity": (one["tokens"] == baseline
+                         and two["tokens"] == baseline),
+        "no_new_programs": one["programs"].get(0) == solo_programs,
+    }
+    report = {
+        "bench": "serve.fleet",
+        "config": {k: getattr(args, k) for k in
+                   ("requests", "sessions", "max_batch", "max_len",
+                    "min_new", "max_new", "d_model", "depth",
+                    "page_size", "virtual_step_s", "seed")},
+        "solo": {"programs": solo_programs},
+        "fleet": {
+            str(r): {k: v for k, v in leg.items() if k != "tokens"}
+            for r, leg in legs.items()
+        },
+        "scaling_1_to_2": round(scaling, 4),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+    out = pathlib.Path(args.out)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report, indent=2))
+    print(f"fleet-bench: {'OK' if report['ok'] else 'FAILED'} — "
+          f"scaling {scaling:.2f}x, p99 {one['p99_latency_s']:.2f}s -> "
+          f"{two['p99_latency_s']:.2f}s")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

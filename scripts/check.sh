@@ -222,6 +222,34 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu python -m pytest \
   || { echo "check.sh: multichip chaos smoke failed" >&2
        exit 1; }
 
+echo "== fleet-smoke: kill-a-replica failover + scaling bench gates =="
+# ServeFleet acceptance (README.md "Serve fleet"): two replica workers on
+# the 8-virtual-device harness, replica 0 killed in-process after its
+# first completion with the journal tail unflushed. Gates inside the CLI:
+# the kill fired (non-vacuity), every admitted request completed, token
+# streams bit-identical to an uninterrupted solo baseline, surviving
+# replica zero restarts, >= 1 request failed over, and no device program
+# outside the engine's static bucket/pad universe. Then the fleet bench
+# re-checks BENCH_FLEET.json's committed gates: >= 1.8x virtual
+# throughput from 1 -> 2 replicas at no worse p99, >= 1 affinity- and
+# >= 1 fallback-routed request, and 1-replica programs == solo programs.
+fleet_dir=$(mktemp -d)
+timeout -k 10 300 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python -m tpu_dist.serve --fleet --fleet-replicas 2 \
+  --plan replica-kill@req1:replica0 --requests 10 --max-batch 4 \
+  --max-len 32 --max-new 8 --vocab 32 --d-model 16 --depth 1 \
+  --num-heads 2 --page-size 8 --workdir "$fleet_dir" \
+  --report FLEET_CHAOS.json >/dev/null \
+  || { echo "check.sh: fleet chaos gates failed (see FLEET_CHAOS.json)" >&2
+       exit 1; }
+rm -rf "$fleet_dir"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python benchmarks/fleet_bench.py >/dev/null \
+  || { echo "check.sh: fleet bench gates failed (see BENCH_FLEET.json)" >&2
+       exit 1; }
+
 echo "== analysis-concurrency: host-runtime thread-safety & liveness =="
 # Pure-AST interprocedural pass (no jax backend, no trace): SC4xx
 # thread-safety + SC5xx liveness/protocol rules over the host runtime,

@@ -556,6 +556,9 @@ class TestRestoreFailureModes:
 
 
 class TestElasticChaosCli:
+    # ~13s of subprocess attempts; check.sh's elastic-smoke stage runs the
+    # identical scenario, so the pytest copy rides outside tier-1.
+    @pytest.mark.slow
     def test_preempt_and_reshape_end_to_end(self, tmp_path):
         """The tentpole acceptance demo (scripts/check.sh elastic-smoke):
         SIGTERM at step 5 → bounded drain → checkpoint published →

@@ -459,6 +459,9 @@ class TestInjectorGangIdentity:
 
 
 class TestStepRejoinCli:
+    # ~43s of subprocess gangs; check.sh's elastic-rejoin-smoke stage runs
+    # the identical scenario, so the pytest copy rides outside tier-1.
+    @pytest.mark.slow
     def test_step_rejoin_end_to_end(self, tmp_path):
         """The acceptance demo (scripts/check.sh elastic-rejoin-smoke):
         kill rank 1 mid-epoch-1, measure recovery from DETECTION for both

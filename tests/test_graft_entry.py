@@ -40,6 +40,10 @@ def test_dryrun_multichip_direct_call_like_driver():
     assert "DRIVER-OK" in proc.stdout
 
 
+# Worst-case variant of the direct-call test above: ~18s re-compiling the
+# same three programs the like-driver path already pins, so it rides
+# outside tier-1's budget.
+@pytest.mark.slow
 def test_dryrun_multichip_direct_call_after_jax_init():
     # Worst case: the calling process has already initialized a (1-device)
     # JAX backend before invoking the dryrun.
@@ -55,11 +59,12 @@ def test_dryrun_multichip_direct_call_after_jax_init():
 
 
 @pytest.mark.parametrize("n,timeout", [
-    (4, 600),
-    # A quarter of BASELINE.md's 32-core story ran at n=8 since r1; the
-    # 16-device point holds the next doubling in the suite (r4). It is
-    # ~19s of pure re-compile of the same three programs the n=4 point
-    # already pins, so it rides outside tier-1's 870s budget.
+    # The like-driver test exercises the child path transitively (its
+    # direct call re-execs into ``python __graft_entry__.py`` at n=8), so
+    # the explicit n=4 invocation rides outside tier-1 alongside the n=16
+    # doubling (~19s each of pure re-compile of the same three programs
+    # the like-driver path already pins).
+    pytest.param(4, 600, marks=pytest.mark.slow),
     pytest.param(16, 900, marks=pytest.mark.slow),
 ])
 def test_dryrun_multichip_child_invocation(n, timeout):
@@ -76,6 +81,9 @@ def test_dryrun_multichip_child_invocation(n, timeout):
     assert f"dryrun_multichip({n}): OK" in proc.stdout
 
 
+# ~18s re-compiling the same three programs the like-driver path already
+# pins; the inline/no-reexec semantics it adds ride outside tier-1.
+@pytest.mark.slow
 def test_dryrun_multichip_inline_when_devices_suffice():
     # Inside the pytest process the conftest already provides an 8-device
     # virtual CPU mesh, so the call must run inline (no subprocess): poison

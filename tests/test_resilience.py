@@ -272,6 +272,9 @@ class TestChaosCli:
 
         assert main(["--plan", "  "]) == 2
 
+    # ~18s of subprocess attempts; check.sh's resilience-smoke stage runs
+    # the identical scenario, so the pytest copy rides outside tier-1.
+    @pytest.mark.slow
     def test_kill_worker_chaos_run_end_to_end(self, tmp_path):
         """The acceptance demo (scripts/check.sh resilience-smoke): kill at
         global step 5 on attempt 0, then on the restarted attempt kill again

@@ -605,6 +605,9 @@ class TestServeSupervisorChaosE2E:
     journal replay → bit-identical final greedy streams, all through the
     real ``--chaos`` CLI (subprocess workers, shared journal)."""
 
+    # ~20s of subprocess engines; check.sh's serve-chaos-smoke stage runs
+    # the identical scenario, so the pytest copy rides outside tier-1.
+    @pytest.mark.slow
     def test_engine_crash_chaos_end_to_end(self, tmp_path, capsys):
         from tpu_dist.serve.cli import main
 

@@ -475,6 +475,11 @@ class TestDogfood:
         errors = [f for f in findings if f.severity >= Severity.ERROR]
         assert errors == [], [f.render() for f in errors]
 
+    # ~12s of fresh-interpreter entry-point tracing; check.sh's shardcheck
+    # stage runs the identical CLI over tpu_dist/ + examples/, so the
+    # pytest copy rides outside tier-1 (test_repo_lints_clean keeps the
+    # in-process lint coverage).
+    @pytest.mark.slow
     def test_cli_self_check_exits_zero(self):
         # The acceptance-criterion invocation, end to end in a fresh
         # interpreter: AST lint + built-in entry-point traces over the

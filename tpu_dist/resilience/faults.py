@@ -22,6 +22,8 @@ grammar used on the CLI::
     engine_crash@req4              # kill the serve engine at the 4th completion
     decode_stall@req2:2s           # hang a decode step 2 s mid-serve
     request_storm@req0:x400        # 400-request burst at submission 0
+    replica_kill@req2:replica0     # kill fleet replica 0 at its 2nd completion
+    router_storm@req0:x64          # 64-request burst through the fleet router
     job_kill@job1                  # kill job 1's worker at its step 1
     job_kill@job1:abort            # same, exiting EXIT_JOB_ABORT (abandon)
     job_hang@job0:5s:step2         # hang job 0's worker 5 s at its step 2
@@ -95,6 +97,19 @@ Fault kinds (dispatch lives in :mod:`tpu_dist.resilience.injector`):
     hang as a fault; ``request_storm`` injects ``:xM`` extra burst requests
     into the load generator at submission index N, the overload that load
     shedding must absorb.
+``replica_kill`` / ``router_storm``
+    FLEET faults, addressed by ``@reqN`` like the serve kinds but armed
+    only by the multi-replica router (:mod:`tpu_dist.serve.fleet`) — a
+    solo engine's :class:`~tpu_dist.resilience.injector.ServeFaultInjector`
+    never arms them, so a fleet plan reaching a solo run is inert by
+    construction. ``replica_kill`` kills ONE replica worker (``:replicaR``
+    picks which, default 0) at that replica's N-th completed request,
+    in-process at the decode-step boundary BEFORE the journal flush — the
+    unflushed tail is genuinely lost, and the router must recover the
+    dead replica's in-flight work from its on-disk journal onto the
+    survivors. ``router_storm`` injects ``:xM`` extra burst requests at
+    router submission index N — the fleet-level overload that per-replica
+    admission control must shed without wedging the router.
 ``job_kill`` / ``job_hang``
     MULTI-JOB faults, addressed by the job coordinate ``@jobN`` — the
     submission index a :class:`~tpu_dist.jobs.scheduler.JobPool` assigns
@@ -123,11 +138,20 @@ KINDS = ("kill", "preempt", "delay_collective", "hang_collective",
          "checkpoint_fail", "kill_during_save", "slow_input",
          "nan_loss", "grad_spike", "bitflip", "corrupt_batch",
          "engine_crash", "decode_stall", "request_storm",
+         "replica_kill", "router_storm",
          "job_kill", "job_hang")
 
 #: Fault kinds that target the SERVING path; they address the request
 #: coordinate (``@reqN``) instead of a training step/epoch.
-SERVE_KINDS = frozenset({"engine_crash", "decode_stall", "request_storm"})
+SERVE_KINDS = frozenset({"engine_crash", "decode_stall", "request_storm",
+                         "replica_kill", "router_storm"})
+
+#: The subset of serve kinds only a MULTI-REPLICA fleet router arms
+#: (:mod:`tpu_dist.serve.fleet`). A solo engine's ServeFaultInjector
+#: never matches these, and the single-engine chaos driver rejects plans
+#: containing them — a fleet fault must never silently no-op in a solo
+#: run and report a vacuous pass.
+FLEET_KINDS = frozenset({"replica_kill", "router_storm"})
 
 #: Fault kinds that target ONE JOB of a packed multi-job pool; they carry
 #: the job coordinate (``@jobN``) and are armed only by workers whose
@@ -155,6 +179,8 @@ _ALIASES = {
     "engine-crash": "engine_crash",
     "decode-stall": "decode_stall",
     "request-storm": "request_storm",
+    "replica-kill": "replica_kill",
+    "router-storm": "router_storm",
     "job-kill": "job_kill",
     "job-hang": "job_hang",
 }
@@ -316,11 +342,15 @@ class FaultSpec:
             raise ValueError(
                 f"fault {self.kind!r} is not a job kind; @jobN targets "
                 f"only {sorted(JOB_KINDS)}")
-        if ((self.leaf is not None or self.replica is not None)
-                and self.kind != "bitflip"):
+        if self.leaf is not None and self.kind != "bitflip":
             raise ValueError(
-                f"fault {self.kind!r} does not take :leafK/:replicaR; "
-                f"those address only bitflip")
+                f"fault {self.kind!r} does not take :leafK; "
+                f"that addresses only bitflip")
+        if (self.replica is not None
+                and self.kind not in ("bitflip", "replica_kill")):
+            raise ValueError(
+                f"fault {self.kind!r} does not take :replicaR; "
+                f"that addresses only bitflip and replica_kill")
         if self.kind == "checkpoint_fail" and self.mode not in (
                 "transient", "truncate"):
             raise ValueError(
@@ -492,7 +522,9 @@ def describe(plan: FaultPlan) -> Sequence[str]:
         when = ("every attempt" if f.attempt is None
                 else f"attempt {f.attempt}")
         addr = ""
-        if f.leaf is not None or f.replica is not None:
+        if f.kind == "replica_kill":
+            addr = f" [replica {0 if f.replica is None else f.replica}]"
+        elif f.leaf is not None or f.replica is not None:
             addr = (f" [leaf {0 if f.leaf is None else f.leaf}"
                     f", replica {f.rank if f.replica is None else f.replica}]")
         out.append(f"{f.kind} @ {where} on rank {f.rank} ({when}){addr}")

@@ -41,8 +41,8 @@ import numpy as np
 
 from tpu_dist.observe import metrics
 from tpu_dist.resilience import events
-from tpu_dist.resilience.faults import (FAULT_PLAN_ENV, FaultPlan,
-                                        SERVE_KINDS, describe)
+from tpu_dist.resilience.faults import (FAULT_PLAN_ENV, FLEET_KINDS,
+                                        FaultPlan, SERVE_KINDS, describe)
 from tpu_dist.serve.scheduler import DONE, EVICTED, SHED
 
 #: Default p99 latency target (virtual seconds) for the storm gate when
@@ -239,6 +239,14 @@ def run_chaos(args) -> int:
               "the token-parity gate is a greedy guarantee", file=sys.stderr)
         return 2
     plan = FaultPlan.parse(args.plan) if args.plan else None
+    fleet_faults = ([f for f in plan.faults if f.kind in FLEET_KINDS]
+                    if plan else [])
+    if fleet_faults:
+        print(f"error: fault kind(s) "
+              f"{sorted({f.kind for f in fleet_faults})} target the fleet "
+              f"router; run them through --fleet, not --chaos",
+              file=sys.stderr)
+        return 2
     serve_faults = ([f for f in plan.faults if f.kind in SERVE_KINDS]
                     if plan else [])
     if not serve_faults:

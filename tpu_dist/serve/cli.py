@@ -263,8 +263,25 @@ def main(argv=None) -> int:
     p.add_argument("--workdir", default=None)
     p.add_argument("--report", default=None,
                    help="also write the chaos JSON report to this path")
+    p.add_argument("--fleet", action="store_true",
+                   help="multi-replica fleet run: sessioned workload "
+                        "through the prefix-affinity router, token-parity "
+                        "+ failover gates (serve/fleet.py)")
+    p.add_argument("--fleet-replicas", type=int, default=2,
+                   help="initial replica count for --fleet")
+    p.add_argument("--fleet-sessions", type=int, default=4,
+                   help="distinct shared-prefix sessions in the --fleet "
+                        "workload (affinity anti-vacuity needs >= 1)")
+    p.add_argument("--devices-per-replica", type=int, default=None,
+                   help="lease a submesh of this many devices per replica "
+                        "via the jobs runtime (default: no lease, engines "
+                        "share the default strategy)")
     args = p.parse_args(argv)
 
+    if args.fleet:
+        from tpu_dist.serve.fleet import run_fleet
+
+        return run_fleet(args)
     if args.worker:
         from tpu_dist.serve.chaos import run_worker
 

@@ -553,6 +553,67 @@ class ServeEngine:
             self.journal.record_submit(req)
         return req
 
+    def adopt_request(self, prompt: Sequence[int], *,
+                      generated: Sequence[int] = (),
+                      max_new_tokens: int = 32,
+                      eos_id: Optional[int] = None,
+                      deadline_s: Optional[float] = None,
+                      replays: int = 0) -> Request:
+        """Adopt another engine's in-flight request (fleet failover).
+
+        The caller — the fleet router, replaying a dead replica's journal
+        onto a survivor — hands over the prompt plus every token the dead
+        replica already emitted. The adopted request gets a FRESH rid from
+        THIS engine's :meth:`Scheduler.reserve_rid` (two replicas' rid
+        spaces overlap by construction, so the donor rid must never be
+        pinned here), its full submit+token trail is re-journaled so a
+        later crash of the survivor replays it like native work, and the
+        greedy continuation re-prefills ``prompt + generated`` — token-
+        identical to an uninterrupted run, same as solo journal recovery.
+
+        Mirrors ``_recover_from_journal``'s edge handling: journaled
+        tokens already satisfying the stop condition finish here without
+        a slot; a request seen ACTIVE in more than ``retry_budget``
+        crashes is shed (cause ``retry_budget``) instead of re-admitted.
+        """
+        prompt = [int(t) for t in prompt]
+        if len(prompt) > self.max_len - 1:
+            raise ValueError(
+                f"prompt of {len(prompt)} tokens does not fit a "
+                f"{self.max_len}-position cache slot (need >= 1 free)")
+        if self.paged:
+            self._paging.check_fits(
+                min(len(prompt) + int(max_new_tokens), self.max_len))
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      eos_id=eos_id, deadline_s=deadline_s,
+                      generated=[int(t) for t in generated],
+                      replays=int(replays))
+        req.rid = self.scheduler.reserve_rid()
+        if self.journal is not None:
+            self.journal.record_submit(req)
+            for t in req.generated:
+                self.journal.record_token(req.rid, t)
+        hit_eos = req.eos_id is not None and req.eos_id in req.generated
+        if hit_eos or len(req.generated) >= req.max_new_tokens:
+            # The donor's work was complete; only its terminal record
+            # died with it. Finish without ever taking a slot.
+            now = self.clock()
+            req.status = DONE
+            req.finish_reason = "eos" if hit_eos else "length"
+            req.submit_s = now
+            req.finish_s = now
+            self.finished.append(req)
+            if self.journal is not None:
+                self.journal.record_finish(req)
+            self._done_count += 1
+            metrics.inc("serve.requests.completed")
+            return req
+        if req.generated and req.replays + 1 > self.retry_budget:
+            return self._shed(req, "retry_budget", journaled=True)
+        self.scheduler.submit(req, now=self.clock(), rid=req.rid)
+        metrics.inc("serve.requests.adopted")
+        return req
+
     # -- overload protection --------------------------------------------------
 
     def _projected_ttft_s(self) -> float:

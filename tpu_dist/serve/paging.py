@@ -290,6 +290,33 @@ class PrefixCache:
         return [tuple(int(t) for t in prompt[i:i + ps])
                 for i in range(0, len(prompt), ps)]
 
+    @staticmethod
+    def prompt_digest(tokens, page_size: int) -> bytes:
+        """Chained prefix digest of a prompt, computable without an
+        engine or allocator — the fleet router's affinity key.
+
+        Walks the same chain :meth:`lookup`/:meth:`register_full` walk:
+        ``d_i = H(d_{i-1}, chunk_i)`` over the full ``page_size`` chunks
+        (so the result for a page-aligned prompt IS the ``_full`` cache
+        key of its last page), then folds a partial tail chunk in with
+        one more ``H(parent, tail)`` step — the hashed form of the
+        ``(parent digest, tail tokens)`` key ``_partial`` uses. Two
+        prompts share a digest iff the cache would key them identically,
+        which is exactly the warm-replica question the router asks.
+        """
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        toks = [int(t) for t in tokens]
+        digest = _ROOT
+        k_full = len(toks) // page_size
+        for i in range(k_full):
+            digest = _digest(
+                digest, tuple(toks[i * page_size:(i + 1) * page_size]))
+        tail = tuple(toks[k_full * page_size:])
+        if tail:
+            digest = _digest(digest, tail)
+        return digest
+
     def lookup(self, prompt) -> Tuple[List[int], int, bool]:
         """Longest cached prefix of ``prompt``.
 
