@@ -1,0 +1,127 @@
+"""Parameter-server benchmark: async bounded-staleness vs sync control.
+
+What it measures
+----------------
+Drives the full ``--ps-chaos`` leg set (tpu_dist.resilience.ps_chaos) —
+clean async reference, calibrated 10x straggler under both the async PS
+model and the gang-synchronous control, kill-worker, server-kill — and
+distils the result into ``BENCH_PS.json``. Every number is measured on
+this host in this run: the straggler delay is derived from the clean
+leg's own step time, and the sync collapse the async model is judged
+against is the control's measured throughput, not an assumption.
+
+Gates (exit 1 on failure)
+-------------------------
+* **straggler cheap (async)**: 10x straggler costs < 10% apply
+  throughput vs the clean async leg;
+* **sync collapses**: the same straggler under the sync control loses
+  > 50% throughput (the comparison is real);
+* **convergence**: async final loss within ``--tol`` of the sync
+  control on the same budget;
+* **kill-worker free**: a fault-killed worker causes ZERO supervisor
+  restarts and the server still completes the full apply budget;
+* **server restore**: a killed server restarts, restores from the
+  published checkpoint (``ps_server_restore``), and completes;
+* **anti-vacuity**: every faulted leg logged a ``fault_fired`` event.
+
+Writes ``BENCH_PS.json`` (see ``--out``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+# The chaos legs spawn server/worker children with `-m`; they need the
+# repo importable regardless of the bench invoker's cwd.
+os.environ["PYTHONPATH"] = _REPO + os.pathsep + os.environ.get(
+    "PYTHONPATH", "")
+
+from tpu_dist.resilience import cli as chaos_cli
+from tpu_dist.resilience.ps_chaos import run_ps_chaos
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="BENCH_PS.json")
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--staleness", type=int, default=4)
+    ap.add_argument("--tol", type=float, default=0.1)
+    ap.add_argument("--workdir", default=None)
+    args = ap.parse_args(argv)
+
+    workdir = pathlib.Path(args.workdir
+                           or tempfile.mkdtemp(prefix="ps-bench-"))
+    report_path = workdir / "ps_chaos_report.json"
+    chaos_args = chaos_cli.build_parser().parse_args([
+        "--ps-chaos", "--ps-legs", "all",
+        "--ps-world", str(args.world), "--ps-epochs", str(args.epochs),
+        "--ps-steps", str(args.steps), "--ps-batch", str(args.batch),
+        "--ps-staleness", str(args.staleness), "--ps-tol", str(args.tol),
+        "--workdir", str(workdir), "--report", str(report_path)])
+    rc = run_ps_chaos(chaos_args, workdir)
+    rep = json.loads(report_path.read_text())
+
+    keep = ("ok", "sync", "wall_s", "throughput_sps", "final_loss",
+            "applies", "applied_by_rank", "server_restarts",
+            "worker_exit_codes", "faults_fired", "server_restores")
+    legs = {name: {k: leg.get(k) for k in keep}
+            for name, leg in rep.get("legs", {}).items()}
+    strag = rep.get("straggler", {})
+    conv = rep.get("convergence", {})
+    killw = rep.get("legs", {}).get("kill_worker", {})
+    skill = rep.get("legs", {}).get("server_kill", {})
+    faulted = [l for n, l in rep.get("legs", {}).items()
+               if n != "clean_async" and n != "clean_sync"]
+    gates = {
+        "straggler_async_cheap":
+            (strag.get("async_throughput_ratio") or 0.0) >= 0.9,
+        "sync_control_collapses":
+            (strag.get("sync_throughput_ratio") or 1.0) < 0.5,
+        "bounded_staleness_converges":
+            conv.get("delta") is not None
+            and conv["delta"] <= conv.get("tol", args.tol),
+        "kill_worker_zero_restarts":
+            killw.get("server_restarts") == 0
+            and killw.get("applies") == args.epochs * args.steps
+            * args.world,
+        "server_kill_restores":
+            bool(skill.get("server_restores"))
+            and (skill.get("server") or {}).get("restored_from"),
+        "anti_vacuity_faults_fired":
+            bool(faulted) and all(l.get("faults_fired", 0) > 0
+                                  for l in faulted),
+        "all_gates_in_runner": rc == 0,
+    }
+    report = {
+        "bench": "ps.chaos",
+        "config": {k: getattr(args, k) for k in
+                   ("world", "epochs", "steps", "batch", "staleness",
+                    "tol")},
+        "straggler": strag,
+        "convergence": conv,
+        "legs": legs,
+        "gates": {k: bool(v) for k, v in gates.items()},
+        "ok": rc == 0 and all(gates.values()),
+    }
+    out = pathlib.Path(args.out)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    print(f"ps-bench: {'OK' if report['ok'] else 'FAILED'} — "
+          f"async straggler ratio "
+          f"{strag.get('async_throughput_ratio')}, sync "
+          f"{strag.get('sync_throughput_ratio')}, convergence delta "
+          f"{conv.get('delta')} -> {out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,8 +2,8 @@
 # Repo gate: shardcheck static analysis, the resilience smoke chaos run,
 # the elastic preempt+reshape chaos run, the observe telemetry smoke/bench,
 # the checkpoint stall bench, the serve load bench, the step-execution
-# overlap bench, the concurrency/liveness analysis, then the tier-1 test
-# suite.
+# overlap bench, the parameter-server chaos smoke, the concurrency/liveness
+# analysis, then the tier-1 test suite.
 #
 # Usage: scripts/check.sh
 #
@@ -249,6 +249,24 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
   python benchmarks/fleet_bench.py >/dev/null \
   || { echo "check.sh: fleet bench gates failed (see BENCH_FLEET.json)" >&2
        exit 1; }
+
+echo "== ps-chaos-smoke: async PS straggler + kill-worker legs =="
+# The parameter-server acceptance demo (README.md "Parameter-server
+# training"): one supervised server + 2 unsupervised workers per leg over
+# the atomic-file transport. The straggler leg arms a PERMANENT
+# delay@step* on rank 1 calibrated to 10x the clean leg's measured step
+# time and requires async apply throughput >= 0.9x clean; the kill leg
+# fault-kills rank 1 mid-run and requires ZERO supervisor restarts, the
+# FULL apply budget covered by the survivor, and final loss within
+# tolerance of the clean async reference. Both legs are anti-vacuous
+# (fault_fired required). The full leg set — sync-control collapse,
+# bounded-staleness convergence, server-kill checkpoint restore — runs in
+# benchmarks/ps_bench.py (committed BENCH_PS.json).
+ps_dir=$(mktemp -d /tmp/tpu-dist-ps.XXXXXX)
+timeout -k 10 420 env JAX_PLATFORMS=cpu python -m tpu_dist.resilience \
+  --ps-chaos --ps-legs straggler,kill --workdir "$ps_dir" >/dev/null \
+  || { echo "check.sh: ps chaos gates failed (see $ps_dir)" >&2; exit 1; }
+rm -rf "$ps_dir"
 
 echo "== analysis-concurrency: host-runtime thread-safety & liveness =="
 # Pure-AST interprocedural pass (no jax backend, no trace): SC4xx

@@ -293,6 +293,94 @@ emit({{"process_index": jax.process_index(), "wrote": wrote,
         assert r0["digest"] == r1["digest"]
 
 
+class TestCrossHostTelemetry:
+    def test_step_time_exchange_names_straggler_by_rank(self):
+        """Telemetry's per-epoch step-time exchange across a REAL
+        2-process gang: rank 1's input pipeline is artificially slow, and
+        after the ``host_all_gather`` both processes must hold BOTH
+        ranks' mean step times in their registries (not just their own
+        series), with the chief's straggler detector naming rank 1."""
+        body = """
+import os
+import tempfile
+import time
+
+import numpy as np
+import jax
+import tpu_dist as td
+from tpu_dist.observe.telemetry import Telemetry
+from tpu_dist.resilience.events import read_events
+
+strategy = td.MultiWorkerMirroredStrategy()
+rank = jax.process_index()
+
+with strategy.scope():
+    model = td.Sequential([td.models.Dense(8, activation="relu"),
+                           td.models.Dense(4)], input_shape=(4,))
+    model.compile(
+        loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+        optimizer=td.ops.SGD(learning_rate=0.05))
+
+rng = np.random.RandomState(0)
+x = rng.rand(48, 4).astype(np.float32)
+y = rng.randint(0, 4, size=(48,)).astype(np.int32)
+
+# Rank 1 drags: a slow host-side input pipeline, which the step timer
+# books as data_wait and the exchange surfaces to every peer. A filter
+# (not a map) carries the sleep — maps can be hoisted into the compiled
+# device transform, where the sleep would fire once at trace time.
+SLEEP_S = 0.03 if rank == 1 else 0.0
+def slow(a, b):
+    if SLEEP_S:
+        time.sleep(SLEEP_S)
+    return True
+
+ds = td.data.Dataset.from_tensor_slices((x, y)).filter(slow).batch(8)
+opts = td.data.Options()
+opts.experimental_distribute.auto_shard_policy = td.AutoShardPolicy.OFF
+ds = ds.with_options(opts)
+
+workdir = tempfile.mkdtemp()
+os.environ["TPU_DIST_EVENT_LOG"] = workdir + "/events.jsonl"
+tel = Telemetry()
+model.fit(ds, epochs=2, steps_per_epoch=3, verbose=0, callbacks=[tel])
+
+snap = tel.registry.snapshot()
+timing = read_events(workdir + "/events.jsonl", "step_timing")
+flagged = read_events(workdir + "/events.jsonl", "straggler_detected")
+emit({
+    "process_index": rank,
+    "is_chief": td.cluster.is_chief(),
+    "rank_step_gauges": {k: v for k, v in snap["gauges"].items()
+                         if k.endswith(".step_time_s")},
+    "straggler_flags": snap["counters"].get("straggler.flags", 0),
+    "timing_ranks": sorted({e["rank"] for e in timing}),
+    "flagged_ranks": sorted({e["rank"] for e in flagged}),
+})
+"""
+        results = run_workers(body, num_workers=2)
+        assert_all_succeeded(results)
+        by_idx = {r.result["process_index"]: r.result for r in results}
+        for rank, r in by_idx.items():
+            # The exchange landed: every process gauges BOTH ranks.
+            gauges = r["rank_step_gauges"]
+            assert set(gauges) == {"rank0.step_time_s",
+                                   "rank1.step_time_s"}, gauges
+            # And both agree on who is slow — rank 1's injected 30ms per
+            # element (240ms per batch) dominates any honest step time.
+            assert gauges["rank1.step_time_s"] > gauges["rank0.step_time_s"]
+            assert gauges["rank1.step_time_s"] > 0.1
+            # step_timing events are per-process facts: own rank only.
+            assert r["timing_ranks"] == [rank]
+        chief = by_idx[0]
+        assert chief["is_chief"]
+        assert chief["straggler_flags"] >= 1
+        assert chief["flagged_ranks"] == [1]
+        # Detection runs on the chief alone: the peer flags nothing.
+        assert by_idx[1]["straggler_flags"] == 0
+        assert by_idx[1]["flagged_ranks"] == []
+
+
 class TestFaultDetection:
     def test_dead_peer_detected_and_surfaced(self):
         """SURVEY.md §4 item 5: kill one process mid-run; peers must surface a

@@ -92,9 +92,20 @@ class TestStrategies:
             s = MultiWorkerMirroredStrategy(communication=name)
             assert s.communication in (CollectiveCommunication[name],)
 
-    def test_parameter_server_is_documented_nongoal(self):
-        with pytest.raises(NotImplementedError, match="README.md:5-7"):
+    def test_parameter_server_is_a_real_strategy_now(self, tmp_path):
+        """The long-documented non-goal is a second execution model since
+        PR 18: a PS scope needs a session directory (loud ValueError naming
+        the env knob, not a NotImplementedError stub) and a worker scope is
+        single-device and collective-free by construction."""
+        with pytest.raises(ValueError, match="TPU_DIST_PS_DIR"):
             ParameterServerStrategy()
+        s = ParameterServerStrategy(str(tmp_path), role="worker", rank=1,
+                                    num_workers=2, staleness=3, sync=False)
+        assert s.is_worker and not s.is_server
+        assert (s.rank, s.num_workers, s.staleness) == (1, 2, 3)
+        # Single-device mesh: nothing to psum across, even by accident.
+        assert s.mesh.devices.size == 1
+        assert s.num_replicas_in_sync == 1
 
 
 class TestCollectives:

@@ -1,0 +1,325 @@
+"""ParameterServerStrategy unit tests: the host-side file transport
+(atomic publish/push round-trips, arrival-order discovery, apply-log
+durability incl. torn-tail tolerance, env resolvers), the bounded-
+staleness pull gate (blocks past the window, releases on applied counts,
+times out against a silent server, aborts on checksum mismatch), the
+coordinate-derived worker RNG streams, the ``step*`` permanent-straggler
+fault grammar, and the sequential replay-reproducibility contract: a
+recording server's retained packets re-applied in logged order reach
+bit-identical final parameter checksums.
+
+Multi-process behavior (real straggler/kill/server-kill legs) is gated by
+``python -m tpu_dist.resilience --ps-chaos`` / benchmarks/ps_bench.py;
+everything here is single-process and fast.
+"""
+
+import json
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+import tpu_dist as td
+from tpu_dist.cluster import ps_transport
+from tpu_dist.cluster.ps_transport import DEFAULT_STALENESS, PSDir
+from tpu_dist.parallel.ps_strategy import (ParameterServerStrategy, PSServer,
+                                           arrays_to_tree, replay_apply_log,
+                                           tree_to_arrays, worker_step_key)
+from tpu_dist.resilience.faults import WILDCARD_COUNT, FaultPlan
+from tpu_dist.training import integrity
+
+
+def _arrays(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"w": rng.rand(3, 2).astype(np.float32),
+            "b": rng.rand(2).astype(np.float32)}
+
+
+class TestTransport:
+    def test_publish_load_roundtrip(self, tmp_path):
+        psdir = PSDir(tmp_path).ensure()
+        arrays = _arrays()
+        sums = integrity.host_leaf_checksums(arrays)
+        psdir.publish_params(arrays, version=0, applied={0: 0},
+                             checksums=sums)
+        manifest, loaded = psdir.load_published()
+        assert manifest["version"] == 0
+        assert manifest["applied"] == {"0": 0}
+        assert manifest["checksums"] == sums
+        for k in arrays:
+            np.testing.assert_array_equal(loaded[k], arrays[k])
+
+    def test_publish_retains_last_two_snapshots(self, tmp_path):
+        """A reader holding the previous manifest must never lose the race
+        with snapshot GC: version v's publish may delete v-2, not v-1."""
+        psdir = PSDir(tmp_path).ensure()
+        for v in range(4):
+            arrays = _arrays(v)
+            psdir.publish_params(
+                arrays, version=v, applied={0: v},
+                checksums=integrity.host_leaf_checksums(arrays))
+        kept = sorted(p.name for p in psdir.params.glob("params-*.npz"))
+        assert kept == ["params-2.npz", "params-3.npz"]
+        manifest, _ = psdir.load_published()
+        assert manifest["version"] == 3
+
+    def test_push_grad_meta_rides_inside_the_npz(self, tmp_path):
+        """Packet + provenance are ONE atomic file — no sidecar json whose
+        publish could tear away from its arrays."""
+        psdir = PSDir(tmp_path).ensure()
+        arrays = _arrays()
+        path = psdir.push_grad(arrays, rank=1, seq=7,
+                               meta={"base_version": 3, "loss": 0.25})
+        assert path.name == "g-r1-00000007.npz"
+        assert list(psdir.grads.iterdir()) == [path]  # no sidecar
+        meta, loaded = PSDir.load_grad(path)
+        assert (meta["rank"], meta["seq"], meta["base_version"]) == (1, 7, 3)
+        assert meta["loss"] == 0.25
+        for k in arrays:
+            np.testing.assert_array_equal(loaded[k], arrays[k])
+
+    def test_scan_grads_arrival_order_not_name_order(self, tmp_path):
+        """Discovery is by (mtime, name): a high-seq packet that LANDED
+        first is applied first — arrival order is the log's truth."""
+        psdir = PSDir(tmp_path).ensure()
+        p_late = psdir.push_grad(_arrays(), rank=0, seq=5, meta={})
+        p_early = psdir.push_grad(_arrays(), rank=1, seq=0, meta={})
+        t = time.time()
+        os.utime(p_late, ns=(int(t * 1e9), int((t - 5.0) * 1e9)))
+        seen = set()
+        order = psdir.scan_grads(seen=seen)
+        assert [p.name for p in order] == [p_late.name, p_early.name]
+        seen.update(p.name for p in order)
+        assert psdir.scan_grads(seen=seen) == []
+
+    def test_apply_log_survives_torn_tail_and_rewrite(self, tmp_path):
+        psdir = PSDir(tmp_path).ensure()
+        for i in range(3):
+            psdir.append_apply_log({"apply": i + 1, "rank": 0, "seq": i})
+        with open(psdir.apply_log, "a", encoding="utf-8") as f:
+            f.write('{"apply": 4, "rank"')  # crash mid-append
+        recs = psdir.read_apply_log()
+        assert [r["apply"] for r in recs] == [1, 2, 3]
+        psdir.rewrite_apply_log(recs[:1])
+        assert psdir.read_apply_log() == [{"apply": 1, "rank": 0, "seq": 0}]
+
+    def test_control_facts(self, tmp_path):
+        psdir = PSDir(tmp_path).ensure()
+        assert psdir.stop_requested() is None
+        assert psdir.heartbeat_age_s(0) is None
+        psdir.heartbeat(0, step=3)
+        assert psdir.heartbeat_age_s(0) < 5.0
+        psdir.mark_done(1, steps=8)
+        assert psdir.done_ranks() == {1}
+        psdir.write_stop(reason="budget", applies=16)
+        stop = psdir.stop_requested()
+        assert (stop["reason"], stop["applies"]) == ("budget", 16)
+
+    def test_env_resolvers(self, monkeypatch):
+        monkeypatch.setenv(ps_transport.PS_STALENESS_ENV, "7")
+        monkeypatch.setenv(ps_transport.PS_ROLE_ENV, "Server")
+        monkeypatch.setenv(ps_transport.PS_RANK_ENV, "3")
+        monkeypatch.setenv(ps_transport.PS_WORLD_ENV, "5")
+        monkeypatch.setenv(ps_transport.PS_SYNC_ENV, "1")
+        monkeypatch.setenv(ps_transport.PS_PULL_TIMEOUT_ENV, "12.5")
+        assert ps_transport.staleness_from_env() == 7
+        assert ps_transport.role_from_env() == "server"
+        assert ps_transport.rank_from_env() == 3
+        assert ps_transport.world_from_env() == 5
+        assert ps_transport.sync_from_env() is True
+        assert ps_transport.pull_timeout_from_env() == 12.5
+        # Garbage falls back to defaults, never raises mid-launch.
+        monkeypatch.setenv(ps_transport.PS_STALENESS_ENV, "lots")
+        monkeypatch.setenv(ps_transport.PS_ROLE_ENV, "coordinator")
+        monkeypatch.setenv(ps_transport.PS_PULL_TIMEOUT_ENV, "0")
+        assert ps_transport.staleness_from_env() == DEFAULT_STALENESS
+        assert ps_transport.role_from_env() is None
+        assert ps_transport.pull_timeout_from_env() == 1.0  # floor
+        # Rank falls back to the rejoin rank the Supervisor already sets.
+        monkeypatch.delenv(ps_transport.PS_RANK_ENV)
+        monkeypatch.setenv("TPU_DIST_REJOIN_RANK", "2")
+        assert ps_transport.rank_from_env() == 2
+
+
+class TestBoundedStaleness:
+    def _strategy(self, tmp_path, **kw):
+        kw.setdefault("role", "worker")
+        kw.setdefault("rank", 0)
+        kw.setdefault("num_workers", 1)
+        kw.setdefault("staleness", 1)
+        kw.setdefault("sync", False)
+        kw.setdefault("pull_timeout_s", 1.0)
+        return ParameterServerStrategy(str(tmp_path), **kw)
+
+    def _publish(self, psdir, arrays, *, version, applied_mine):
+        psdir.publish_params(
+            arrays, version=version, applied={0: applied_mine},
+            checksums=integrity.host_leaf_checksums(arrays))
+
+    def test_pull_times_out_past_the_staleness_window(self, tmp_path):
+        """2 own pushes unapplied > staleness 1: the pull must BLOCK, and
+        a server that never catches up is a hard error, not a hang."""
+        strategy = self._strategy(tmp_path)
+        strategy._pushed = 2
+        arrays = _arrays()
+        self._publish(strategy.psdir, arrays, version=0, applied_mine=0)
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="pull timed out"):
+            strategy.pull(arrays)
+        assert time.perf_counter() - t0 >= 0.9  # actually blocked
+
+    def test_pull_releases_once_the_server_catches_up(self, tmp_path):
+        strategy = self._strategy(tmp_path)
+        strategy._pushed = 2
+        tree = _arrays()
+        arrays = tree_to_arrays(tree)  # publish/pull share keystr namespace
+        self._publish(strategy.psdir, arrays, version=5, applied_mine=1)
+        params, version = strategy.pull(tree)
+        assert version == 5
+        for k in tree:
+            np.testing.assert_array_equal(params[k], tree[k])
+
+    def test_pull_returns_none_on_stop(self, tmp_path):
+        strategy = self._strategy(tmp_path)
+        strategy.psdir.write_stop(reason="budget", applies=4)
+        assert strategy.pull(_arrays()) is None
+
+    def test_pull_aborts_on_checksum_mismatch(self, tmp_path):
+        """Transport SDC: a published snapshot whose bytes do not match
+        its manifest's checksums must never train."""
+        strategy = self._strategy(tmp_path)
+        arrays = _arrays()
+        sums = integrity.host_leaf_checksums(arrays)
+        sums["w"] ^= 1
+        strategy.psdir.publish_params(arrays, version=0, applied={0: 0},
+                                      checksums=sums)
+        with pytest.raises(integrity.IntegrityAbort, match="checksum"):
+            strategy.pull(arrays)
+
+    def test_sync_mode_pins_lockstep(self, tmp_path):
+        """Gang-synchronous control: a worker running ahead of its own
+        applies would deadlock the round, so sync pins staleness to 0."""
+        strategy = self._strategy(tmp_path, sync=True, staleness=4)
+        assert strategy.staleness == 0
+
+    def test_push_increments_seq_and_embeds_base_version(self, tmp_path):
+        strategy = self._strategy(tmp_path)
+        tree = _arrays()
+        arrays = tree_to_arrays(tree)
+        self._publish(strategy.psdir, arrays, version=3, applied_mine=0)
+        strategy.pull(tree)
+        assert strategy.push(arrays, loss=0.5) == 0
+        assert strategy.push(arrays, loss=0.4) == 1
+        assert strategy.pushed == 2
+        meta, _ = PSDir.load_grad(
+            strategy.psdir.grads / "g-r0-00000001.npz")
+        assert meta["base_version"] == 3
+
+
+class TestWorkerKeys:
+    def test_step_keys_are_deterministic_and_disjoint(self):
+        """Worker RNG is a pure function of (rank, local step) — the
+        property that makes an apply-log replay exact — and streams never
+        collide across ranks or steps."""
+        root = jax.random.PRNGKey(0)
+        keys = {(r, s): tuple(np.asarray(
+                    jax.random.key_data(worker_step_key(
+                        root, rank=r, local_step=s))).tolist())
+                for r in range(3) for s in range(4)}
+        again = worker_step_key(root, rank=1, local_step=2)
+        assert tuple(np.asarray(
+            jax.random.key_data(again)).tolist()) == keys[(1, 2)]
+        assert len(set(keys.values())) == len(keys)
+
+
+class TestFaultGrammar:
+    def test_permanent_straggler_wildcard(self):
+        """``delay@step*:rank1:always:2.5s`` — the chaos runner's straggler
+        plan: the delay alias normalizes, ``step*`` arms at step 0 with an
+        effectively unbounded count, ``always`` fires on every attempt."""
+        plan = FaultPlan.parse("delay@step*:rank1:always:2.5s")
+        (spec,) = plan.faults
+        assert spec.kind == "delay_collective"
+        assert (spec.step, spec.count) == (0, WILDCARD_COUNT)
+        assert spec.seconds == 2.5
+        assert spec.rank == 1
+        assert spec.attempt is None
+        assert spec.due_at_step(0) and spec.due_at_step(10 ** 6)
+        assert spec in plan.for_process(1, attempt=5)
+        assert plan.for_process(0, attempt=0) == []
+
+
+def _tiny_model():
+    m = td.Sequential([td.models.Dense(6, activation="relu"),
+                       td.models.Dense(3)], input_shape=(4,))
+    m.compile(loss=td.ops.SparseCategoricalCrossentropy(from_logits=True),
+              optimizer=td.ops.SGD(learning_rate=0.1))
+    return m
+
+
+class TestReplayReproducibility:
+    def test_server_session_replays_to_identical_checksums(self, tmp_path):
+        """The PS exactness contract: arrival order is nondeterministic
+        across runs, but any run is exactly reproducible GIVEN its apply
+        log. Record a 6-apply session with retained packets, then re-apply
+        them in logged order from the seed init — final parameter
+        checksums must be bit-identical to the published snapshot's."""
+        model = _tiny_model()
+        psdir = PSDir(tmp_path / "ps")
+        psdir.ensure()
+        params = model.init(0)["params"]
+        rng = np.random.RandomState(7)
+        budget = 6
+        for i in range(budget):
+            grads = jax.tree_util.tree_map(
+                lambda p: rng.normal(scale=0.1,
+                                     size=np.shape(p)).astype(np.float32),
+                params)
+            psdir.push_grad(tree_to_arrays(grads), rank=0, seq=i,
+                            meta={"base_version": i, "loss": 1.0 - 0.1 * i})
+        server = PSServer(model, psdir, num_workers=1, budget=budget,
+                          seed=0, checksum_every=2, retain_grads=True)
+        stats = server.run()
+        assert stats["applies"] == budget
+        assert stats["stop_reason"] == "budget"
+        assert stats["applied_by_rank"] == {"0": budget}
+        assert psdir.stop_requested()["reason"] == "budget"
+        log = psdir.read_apply_log()
+        applies = [r for r in log if "rank" in r]
+        assert [r["seq"] for r in applies] == list(range(budget))
+        epochs = [r for r in log if r.get("event") == "checksum_epoch"]
+        assert [r["applies"] for r in epochs] == [2, 4, 6]
+
+        manifest, final_arrays = psdir.load_published()
+        assert manifest["version"] == budget
+        replay = replay_apply_log(psdir, _tiny_model(), seed=0)
+        assert replay["applies"] == budget
+        assert replay["checksums"] == manifest["checksums"]
+        assert replay["checksums"] == integrity.host_leaf_checksums(
+            final_arrays)
+
+    def test_replay_refuses_gced_packets(self, tmp_path):
+        """GC'd packets cannot be replayed: the error names the retention
+        knob instead of silently replaying a shorter session."""
+        model = _tiny_model()
+        psdir = PSDir(tmp_path / "ps").ensure()
+        psdir.append_apply_log({"apply": 1, "rank": 0, "seq": 0})
+        with pytest.raises(FileNotFoundError, match="retain_grads"):
+            replay_apply_log(psdir, model, seed=0)
+
+    def test_tree_roundtrip_and_shape_guard(self):
+        params = {"a": np.ones((2, 3), np.float32),
+                  "b": [np.zeros((4,), np.float32)]}
+        arrays = tree_to_arrays(params)
+        back = arrays_to_tree(params, arrays)
+        assert jax.tree_util.tree_structure(back) == (
+            jax.tree_util.tree_structure(params))
+        bad = dict(arrays)
+        key = next(iter(bad))
+        bad[key] = np.zeros((9, 9), np.float32)
+        with pytest.raises(ValueError, match="shape"):
+            arrays_to_tree(params, bad)
+        with pytest.raises(KeyError, match="missing"):
+            arrays_to_tree(params, {})

@@ -200,6 +200,44 @@ class TestFleetRouting:
                                           set()).add(fr.replica)
                 assert all(len(v) == 1 for v in by_session.values())
 
+    def test_hot_prefix_load_shed_overrides_affinity(self, model):
+        """A hotspot session pins one replica; once that replica's queue
+        runs ``affinity_load_slack`` outstanding requests past the
+        coldest one, further hot requests shed to the cold replica
+        (route == 'overridden', counted) WITHOUT re-pinning — after the
+        queue drains the session snaps back to its warm replica.
+
+        Outstanding counters only decay on the main-thread drain, so a
+        burst submitted without draining sees a deterministic decision
+        sequence regardless of worker timing."""
+        fleet = ServeFleet(_factory(model), replicas=2, page_size=PAGE,
+                           affinity_load_slack=3)
+        fleet.start()
+        hot = list(range(1, PAGE + 1))       # one full page: real digest
+        first = fleet.submit(hot + [1], max_new_tokens=2)
+        assert first.route == "fallback"     # first visit pins
+        pin, cold = first.replica, 1 - first.replica
+        burst = [fleet.submit(hot + [2], max_new_tokens=2)
+                 for _ in range(6)]
+        # Leads vs the cold replica: 1,2,3 -> affinity; 4 -> shed;
+        # 3 -> affinity; 4 -> shed.
+        assert [fr.route for fr in burst] == [
+            "affinity", "affinity", "affinity", "overridden",
+            "affinity", "overridden"]
+        assert [fr.replica for fr in burst] == [
+            pin, pin, pin, cold, pin, cold]
+        assert fleet.route_counts == {
+            "affinity": 4, "fallback": 1, "affinity_overridden": 2}
+        fleet.drain(timeout_s=60.0)
+        # Shedding never migrated the pin: the drained session still
+        # routes to its warm replica.
+        after = fleet.submit(hot + [3], max_new_tokens=2)
+        assert after.route == "affinity" and after.replica == pin
+        fleet.drain(timeout_s=60.0)
+        fleet.close()
+        assert all(fr.status == DONE
+                   for fr in [first, after] + burst)
+
     def test_short_prompts_route_stateless(self, model):
         """Prompts under one page have no reusable pages: least-loaded
         spread, never pinned to one replica by a shared root digest."""

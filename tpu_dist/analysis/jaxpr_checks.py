@@ -820,6 +820,61 @@ def _trace_integrity_audit_checksum_sharded():
     return jax.make_jaxpr(fn)(*leaves)
 
 
+def _trace_ps_worker_step():
+    """The async PS worker's local step exactly as ``_fit_ps`` compiles
+    it (training/trainer.py): forward/backward ONLY — no optimizer update
+    (the server owns opt state) and NO collective anywhere, which is the
+    load-bearing property of the execution model: a worker's hot loop
+    must never block on a peer, so a straggler or a dead rank cannot
+    stall it. The baseline pins that collective count at zero."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from tpu_dist.models.cnn import build_and_compile_cnn_model
+    from tpu_dist.parallel.ps_strategy import ParameterServerStrategy
+    from tpu_dist.training.trainer import Trainer
+
+    strategy = ParameterServerStrategy(
+        tempfile.mkdtemp(prefix="psa-"), role="worker", rank=0,
+        num_workers=1, staleness=4, sync=False)
+    with strategy.scope():
+        model = build_and_compile_cnn_model(learning_rate=0.01)
+    trainer = Trainer(model)
+    step = trainer._build_ps_worker_step()
+    trainer.ensure_variables()
+    params = trainer.variables["params"]
+    state = trainer.variables["state"]
+    x = np.zeros((8, 28, 28, 1), np.float32)
+    y = np.zeros((8,), np.int32)
+    rng = jax.random.PRNGKey(0)
+    return jax.make_jaxpr(step)(params, state, x, y, rng)
+
+
+def _trace_ps_server_apply():
+    """The PS server's apply program (parallel/ps_strategy.py PSServer):
+    one pushed gradient packet folded into the authoritative params/opt
+    state via ``optimizer.update``. Single-device by construction and
+    collective-free — the server serializes applies in arrival order, so
+    any collective here would be a bug, not a cost."""
+    import tempfile
+
+    import jax
+
+    from tpu_dist.cluster.ps_transport import PSDir
+    from tpu_dist.models.cnn import build_and_compile_cnn_model
+    from tpu_dist.parallel.ps_strategy import PSServer
+
+    model = build_and_compile_cnn_model(learning_rate=0.01)
+    server = PSServer(model, PSDir(tempfile.mkdtemp(prefix="psb-")),
+                      num_workers=1, budget=1)
+    params = server.variables["params"]
+    opt = server.variables["opt"]
+    grads = jax.tree_util.tree_map(jax.numpy.zeros_like, params)
+    return jax.make_jaxpr(server._apply)(params, opt, grads)
+
+
 def _trace_jobs_runtime_train_step():
     """The trainer step built INSIDE a multi-tenant job scope
     (jobs/runtime.py): same probe model as ``training.trainer.train_step``
@@ -900,6 +955,8 @@ ENTRY_POINTS = {
         _trace_integrity_audit_checksum_sharded,
     "jobs.runtime.train_step": _trace_jobs_runtime_train_step,
     "jobs.runtime.decode_step": _trace_jobs_runtime_decode_step,
+    "parallel.ps_strategy.ps_worker_step": _trace_ps_worker_step,
+    "parallel.ps_strategy.ps_server_apply": _trace_ps_server_apply,
 }
 
 #: Argument positions each entry point's production caller donates

@@ -9,10 +9,12 @@ Reproduces the strategy surface the reference exercises (SURVEY.md §2.1 R2,
   cluster (README.md:21-29; tf_dist_example.py:12), with the reference's
   degradation rule: no cluster / one worker behaves like MirroredStrategy
   (README.md:34).
-* :class:`ParameterServerStrategy` is a documented non-goal: the reference
-  mentions async PS training only to recommend against it (README.md:5-7, 13)
-  and never runs it (SURVEY.md D19). Constructing it raises with that
-  explanation.
+* :class:`ParameterServerStrategy` — async bounded-staleness PS training,
+  the one model the reference names but never runs (README.md:5-7, 13;
+  SURVEY.md D19). Long a raising stub here; now a real second execution model
+  in :mod:`tpu_dist.parallel.ps_strategy` (re-exported from this module):
+  server ranks own params + optimizer state, workers pull/push asynchronously
+  over host-side file transport with no collective in the hot loop.
 
 Architecture shift (the heart of the TPU-native design): a TF strategy is an
 *object* that intercepts variable creation, owns cross-device ops and launches
@@ -556,20 +558,15 @@ class MultiWorkerMirroredStrategy(Strategy):
         return bootstrap.is_chief()
 
 
-class ParameterServerStrategy:
-    """Async parameter-server training — intentionally not implemented.
+def __getattr__(name: str):
+    # PEP 562 lazy re-export: ParameterServerStrategy lives in ps_strategy
+    # (which imports Strategy from here), so a top-level import would be
+    # circular. Resolved on first attribute access instead.
+    if name == "ParameterServerStrategy":
+        from tpu_dist.parallel.ps_strategy import ParameterServerStrategy
 
-    The reference describes PS training only to recommend ring-allreduce over
-    it (bandwidth bottleneck at the PS, README.md:5-7) and never demonstrates
-    it (SURVEY.md D19, §2.3). Sync data parallelism via
-    MultiWorkerMirroredStrategy is the supported path.
-    """
-
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "ParameterServerStrategy is a documented non-goal: the reference "
-            "recommends against async PS training (README.md:5-7) and never "
-            "exercises it. Use MultiWorkerMirroredStrategy.")
+        return ParameterServerStrategy
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 _default_strategy: Optional[DefaultStrategy] = None

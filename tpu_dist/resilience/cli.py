@@ -283,7 +283,7 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m tpu_dist.resilience",
         description="Fault-injection chaos runner for tpu_dist training "
                     "jobs: baseline run, supervised chaos run, JSON report.")
-    p.add_argument("--plan", required=True,
+    p.add_argument("--plan", required=False, default=None,
                    help="fault plan: compact spec (kill-worker@step5; "
                         "bitflip additionally takes leaf/shard coordinates, "
                         "e.g. bitflip@step9:leaf1:replica5), inline JSON, "
@@ -329,19 +329,50 @@ def build_parser() -> argparse.ArgumentParser:
                         "reshape_restore to actually happen (else the run "
                         "is vacuous and fails). The baseline runs at the "
                         "first count.")
+    p.add_argument("--ps-chaos", action="store_true",
+                   help="parameter-server chaos legs instead of a --plan "
+                        "run: calibrated 10x straggler (async vs a "
+                        "measured sync collapse), kill-worker (zero "
+                        "restarts), server-kill (checkpoint restore). "
+                        "Fault plans are derived per leg; --plan is "
+                        "ignored")
+    p.add_argument("--ps-world", type=int, default=2,
+                   help="PS worker ranks per leg (default 2)")
+    p.add_argument("--ps-epochs", type=int, default=2)
+    p.add_argument("--ps-steps", type=int, default=4,
+                   help="steps per epoch per worker (budget = "
+                        "epochs*steps*world)")
+    p.add_argument("--ps-batch", type=int, default=8)
+    p.add_argument("--ps-staleness", type=int, default=4,
+                   help="bounded-staleness window for the async legs")
+    p.add_argument("--ps-tol", type=float, default=0.1,
+                   help="max |final_loss| delta for the PS convergence "
+                        "gates (bounded staleness reorders applies, so "
+                        "this is a convergence tolerance, not parity)")
+    p.add_argument("--ps-legs", default="all",
+                   help="comma subset of straggler,kill,server,sync (or "
+                        "'all'); the clean async reference leg always "
+                        "runs")
     return p
 
 
 def main(argv: Optional[list] = None) -> int:
     args = build_parser().parse_args(argv)
-    plan = FaultPlan.parse(args.plan)
-    if not plan:
-        print("error: --plan parsed to an empty fault plan", file=sys.stderr)
-        return 2
     workdir = pathlib.Path(args.workdir or tempfile.mkdtemp(
         prefix="tpu-dist-chaos-"))
     workdir.mkdir(parents=True, exist_ok=True)
     print(f"chaos workdir: {workdir}", file=sys.stderr)
+    if args.ps_chaos:
+        from tpu_dist.resilience.ps_chaos import run_ps_chaos
+        return run_ps_chaos(args, workdir)
+    if not args.plan:
+        print("error: --plan is required (or use --ps-chaos)",
+              file=sys.stderr)
+        return 2
+    plan = FaultPlan.parse(args.plan)
+    if not plan:
+        print("error: --plan parsed to an empty fault plan", file=sys.stderr)
+        return 2
     for line in describe(plan):
         print(f"fault: {line}", file=sys.stderr)
 

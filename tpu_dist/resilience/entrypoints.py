@@ -220,6 +220,86 @@ def demo_train() -> dict:
     }
 
 
+def demo_ps_worker() -> dict:
+    """The PS-chaos worker workload: same CNN/synthetic-MNIST demo as
+    :func:`demo_train`, but fit under a :class:`~tpu_dist.parallel.
+    ps_strategy.ParameterServerStrategy` scope — pull → local step → push,
+    no collective, terminated by the server's STOP. Every worker consumes
+    the SAME dataset (seed 0) so async-vs-sync convergence is tightly
+    comparable on the demo; real deployments shard per rank.
+
+    Configured by ``TPU_DIST_PS_DIR``/``_RANK``/``_WORLD``/``_STALENESS``
+    (+ the ``TPU_DIST_DEMO_*`` knobs above).
+    """
+    from tpu_dist.models.cnn import build_and_compile_cnn_model
+    from tpu_dist.parallel.ps_strategy import ParameterServerStrategy
+
+    epochs = _env_int("TPU_DIST_DEMO_EPOCHS", 3)
+    steps_per_epoch = _env_int("TPU_DIST_DEMO_STEPS_PER_EPOCH", 4)
+    batch = _env_int("TPU_DIST_DEMO_BATCH", 32)
+    ds = demo_dataset(n=batch * steps_per_epoch, batch=batch)
+    strategy = ParameterServerStrategy()
+    with strategy.scope():
+        model = build_and_compile_cnn_model(learning_rate=0.01)
+        history = model.fit(ds, epochs=epochs,
+                            steps_per_epoch=steps_per_epoch, verbose=0)
+    losses = [round(float(l), 10) for l in history.history.get("loss", [])]
+    return {
+        "role": "worker",
+        "rank": strategy.rank,
+        "pushes": strategy.pushed,
+        "final_loss": losses[-1] if losses else None,
+        "losses": losses,
+    }
+
+
+def demo_ps_server() -> dict:
+    """The PS-chaos server workload: owns params + optimizer state, applies
+    pushed gradients until the apply budget (``TPU_DIST_PS_BUDGET``,
+    default epochs*steps*world) is spent, then evaluates the final
+    parameters on the demo dataset — the ``final_loss`` the convergence
+    gate compares against the sync control's."""
+    import jax
+    import numpy as np
+
+    from tpu_dist.cluster import ps_transport
+    from tpu_dist.cluster.ps_transport import PSDir
+    from tpu_dist.models.cnn import build_and_compile_cnn_model
+    from tpu_dist.parallel.ps_strategy import PSServer
+
+    epochs = _env_int("TPU_DIST_DEMO_EPOCHS", 3)
+    steps_per_epoch = _env_int("TPU_DIST_DEMO_STEPS_PER_EPOCH", 4)
+    batch = _env_int("TPU_DIST_DEMO_BATCH", 32)
+    world = ps_transport.world_from_env()
+    budget = _env_int("TPU_DIST_PS_BUDGET", epochs * steps_per_epoch * world)
+    ps_dir = os.environ.get(ps_transport.PS_DIR_ENV)
+    if not ps_dir:
+        raise ValueError(f"demo_ps_server needs ${ps_transport.PS_DIR_ENV}")
+    model = build_and_compile_cnn_model(learning_rate=0.01)
+    server = PSServer(
+        model, PSDir(ps_dir), num_workers=world, budget=budget,
+        sync=ps_transport.sync_from_env(),
+        checkpoint_dir=os.environ.get(CHECKPOINT_DIR_ENV),
+        ckpt_every=_env_int("TPU_DIST_PS_CKPT_EVERY", 8),
+        retain_grads=os.environ.get("TPU_DIST_PS_RETAIN_GRADS") == "1")
+    stats = server.run()
+    # Final-parameter eval on the demo dataset: the PS analog of the sync
+    # demo's last-epoch loss, and the number the convergence gate reads.
+    loss_obj = model.loss
+    fwd = jax.jit(lambda p, s, x: model.apply(p, s, x, training=False)[0])
+    losses = []
+    for xb, yb in demo_dataset(n=batch * steps_per_epoch,
+                               batch=batch).as_numpy_iterator():
+        losses.append(float(loss_obj(
+            fwd(server.variables["params"], server.variables["state"], xb),
+            yb)))
+    return {
+        "role": "server",
+        "final_loss": round(float(np.mean(losses)), 10) if losses else None,
+        **stats,
+    }
+
+
 def run_entry(fn: Callable[[], Optional[dict]]) -> int:
     """Run ``fn`` under the resilience protocol; returns the exit code.
 

@@ -12,6 +12,8 @@ grammar used on the CLI::
     ckpt-fail@epoch0:truncate      # corrupt the epoch-0 checkpoint write
     ckpt-fail@epoch1:x2            # fail the next 2 checkpoint writes
     delay-collective@step3:0.5s    # stall host-level collectives 0.5 s
+    delay@step*:rank1:always:2.5s  # rank 1 is a PERMANENT straggler: stall
+                                   # EVERY step, every attempt (PS chaos)
     hang-collective@step4:rank0    # stall them until the attempt deadline
     slow-input@step2:0.25s:x4      # slow the input pipeline for 4 steps
     nan_loss@step5                 # poison the step-5 batch with NaN
@@ -165,6 +167,7 @@ _ALIASES = {
     "preempt_worker": "preempt",
     "sigterm": "preempt",
     "delay-collective": "delay_collective",
+    "delay": "delay_collective",
     "hang-collective": "hang_collective",
     "ckpt-fail": "checkpoint_fail",
     "ckpt_fail": "checkpoint_fail",
@@ -184,6 +187,11 @@ _ALIASES = {
     "job-kill": "job_kill",
     "job-hang": "job_hang",
 }
+
+#: Firing count carried by the ``@step*`` wildcard target: large enough to
+#: never exhaust in any real run, finite so the injector's per-fault
+#: remaining-count bookkeeping stays an int decrement like every other kind.
+WILDCARD_COUNT = 1_000_000_000
 
 #: Environment variable a worker reads its plan from (set by the CLI /
 #: Supervisor; also settable by hand for code-edit-free chaos runs).
@@ -470,11 +478,17 @@ def _parse_compact(spec: str) -> FaultSpec:
     if not parts:
         raise ValueError(f"bad fault spec {spec!r}: missing @step/@epoch/@req")
     m = _TARGET_RE.match(parts[0])
-    if not m:
+    if not m and parts[0] == "step*":
+        # Wildcard step target: due from step 0 with an effectively
+        # unbounded firing count — "this fault is a standing condition",
+        # e.g. a permanent straggler (`delay@step*:rankN:always`).
+        kwargs: dict = {"step": 0, "count": WILDCARD_COUNT}
+    elif not m:
         raise ValueError(
             f"bad fault target {parts[0]!r} in {spec!r}: "
-            "expected stepN, epochN or reqN")
-    kwargs: dict = {m.group(1): int(m.group(2))}
+            "expected stepN, step*, epochN or reqN")
+    else:
+        kwargs = {m.group(1): int(m.group(2))}
     for mod in parts[1:]:
         if mod.startswith("rank") and mod[4:].isdigit():
             kwargs["rank"] = int(mod[4:])
@@ -517,6 +531,8 @@ def describe(plan: FaultPlan) -> Sequence[str]:
     for f in plan.faults:
         where = (f"job {f.job} step {f.step}" if f.job is not None
                  else f"req {f.req}" if f.req is not None
+                 else "every step" if (f.step == 0
+                                       and f.count >= WILDCARD_COUNT)
                  else f"step {f.step}" if f.step is not None
                  else f"epoch {f.epoch}")
         when = ("every attempt" if f.attempt is None

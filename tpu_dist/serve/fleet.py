@@ -49,8 +49,9 @@ tables) is main-thread-only.  After ``join()`` a worker's engine is
 quiescent and safe to read directly (e.g. ``compiled_programs()``).
 
 Observe: ``fleet.replicas`` gauge, ``fleet.route.affinity_hits`` /
-``fleet.route.fallback`` / ``fleet.failover.replayed`` counters,
-``fleet.autoscale.up`` / ``fleet.autoscale.down``.
+``fleet.route.affinity_overridden`` / ``fleet.route.fallback`` /
+``fleet.failover.replayed`` counters, ``fleet.autoscale.up`` /
+``fleet.autoscale.down``.
 """
 
 from __future__ import annotations
@@ -150,7 +151,7 @@ class FleetRequest:
     #: the prompt is shorter than one page (no reusable pages).
     digest: Optional[bytes]
     replica: int = -1
-    route: Optional[str] = None      # affinity | fallback
+    route: Optional[str] = None      # affinity | overridden | fallback
     chaff: bool = False              # router_storm filler
     failovers: int = 0
     status: Optional[str] = None     # terminal engine status, or "rejected"
@@ -438,7 +439,8 @@ class ServeFleet:
                  autoscale: Optional[AutoscalePolicy] = None,
                  devices_per_replica: Optional[int] = None,
                  runtime=None, storm_vocab: int = 128,
-                 storm_seed: int = 0, poll_s: float = 0.005):
+                 storm_seed: int = 0, poll_s: float = 0.005,
+                 affinity_load_slack: Optional[int] = 8):
         if replicas < 1:
             raise ValueError(f"fleet needs >= 1 replica, got {replicas}")
         self._factory = factory
@@ -476,7 +478,13 @@ class ServeFleet:
         self._initial = int(replicas)
         self._next_index = int(replicas)
         self.requests: Dict[int, FleetRequest] = {}
-        self.route_counts = {"affinity": 0, "fallback": 0}
+        # Hot-prefix load shed: affinity stops being a hard pin once the
+        # pinned replica is this many outstanding requests ahead of the
+        # least-loaded one (None disables the override entirely).
+        self._affinity_load_slack = (None if affinity_load_slack is None
+                                     else max(0, int(affinity_load_slack)))
+        self.route_counts = {"affinity": 0, "fallback": 0,
+                             "affinity_overridden": 0}
         self.failover_replayed = 0
         self.deaths: List[dict] = []
         self.autoscale_events: List[dict] = []
@@ -571,9 +579,24 @@ class ServeFleet:
         target = (self._affinity.get(fr.digest)
                   if fr.digest is not None else None)
         if target is not None and target in alive:
-            fr.route = "affinity"
-            self.route_counts["affinity"] += 1
-            metrics.inc("fleet.route.affinity_hits")
+            coldest = min(alive, key=lambda i: (self._outstanding[i], i))
+            if (self._affinity_load_slack is not None
+                    and self._outstanding[target]
+                    - self._outstanding[coldest]
+                    > self._affinity_load_slack):
+                # Hot-prefix load shed: warmth is not worth queueing this
+                # far behind the coldest replica. Route there for THIS
+                # request only — the affinity pin stays on the hot
+                # replica, so routing snaps back once its queue drains
+                # instead of migrating the prefix on a transient spike.
+                target = coldest
+                fr.route = "overridden"
+                self.route_counts["affinity_overridden"] += 1
+                metrics.inc("fleet.route.affinity_overridden")
+            else:
+                fr.route = "affinity"
+                self.route_counts["affinity"] += 1
+                metrics.inc("fleet.route.affinity_hits")
         else:
             target = min(alive, key=lambda i: (self._outstanding[i], i))
             fr.route = "fallback"

@@ -270,6 +270,47 @@ def _leaf_shard_groups(leaf, mesh):
     return [list(range(mesh.devices.size))]
 
 
+def host_leaf_checksums(arrays: dict) -> dict:
+    """Host-side mirror of :func:`build_audit_checksum`'s per-leaf math:
+    ``{key: uint32 wrap-sum of the f32 bytes}`` for a ``{key: ndarray}``
+    mapping.
+
+    Same bit pattern as the compiled audit (f32 ravel → uint32 bitcast →
+    wrap-sum), but in numpy so the PS server can checksum its authoritative
+    params per apply-epoch and workers can verify pulled snapshots WITHOUT
+    a device program — the PS audit runs where the data already is, on
+    host, between transport and training.
+    """
+    out = {}
+    for key in sorted(arrays):
+        flat = np.ravel(np.asarray(arrays[key], np.float32))
+        out[key] = int(flat.view(np.uint32).sum(dtype=np.uint32))
+    return out
+
+
+def verify_pull_checksums(arrays: dict, manifest: dict) -> None:
+    """Worker-side transport audit: raise :class:`IntegrityAbort` when a
+    pulled parameter snapshot does not match the checksums its manifest
+    published. The server checksummed these exact bytes at publish time, so
+    a mismatch is transport/storage SDC — the one corruption class the
+    server-side apply-epoch audit cannot see."""
+    expected = manifest.get("checksums") or {}
+    if not expected:
+        return
+    missing = sorted(k for k in expected if k not in arrays)
+    if missing:
+        raise IntegrityAbort(
+            f"PS pull: snapshot v{manifest.get('version')} is missing "
+            f"published leaves {missing[:4]}")
+    live = host_leaf_checksums({k: arrays[k] for k in expected})
+    bad = sorted(k for k in expected if live[k] != int(expected[k]))
+    if bad:
+        raise IntegrityAbort(
+            f"PS pull: checksum mismatch on leaves {bad[:4]} of snapshot "
+            f"v{manifest.get('version')} — corruption between server "
+            "publish and worker read")
+
+
 #: Unsigned view dtype per element width for the dtype-aware bit flip.
 _FLIP_VIEWS = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
 

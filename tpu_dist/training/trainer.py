@@ -716,6 +716,25 @@ class Trainer:
         self.ensure_variables(seed)
         self._maybe_invalidate_for_policy()
         self._sync_step_knobs()
+        from tpu_dist.parallel.ps_strategy import ParameterServerStrategy
+
+        if isinstance(self.strategy, ParameterServerStrategy):
+            # The async second execution model: no gang-synchronous step, no
+            # collective in the hot loop — pull → local step → push against
+            # the PS transport instead of the epoch machinery below.
+            if not self.strategy.is_worker:
+                raise ValueError(
+                    "fit() under ParameterServerStrategy runs on worker "
+                    "ranks; the server rank runs PSServer.run() "
+                    "(tpu_dist.parallel.ps_strategy)")
+            if class_weight:
+                raise ValueError(
+                    "class_weight is not supported under "
+                    "ParameterServerStrategy")
+            return self._fit_ps(x, epochs=epochs,
+                                steps_per_epoch=steps_per_epoch,
+                                verbose=verbose, callbacks=callbacks,
+                                initial_epoch=initial_epoch, seed=seed)
         if class_weight is not None:
             class_weight = {int(c): float(w) for c, w in class_weight.items()}
             if any(c < 0 for c in class_weight):
@@ -912,6 +931,156 @@ class Trainer:
             # Runs even on the failure path (e.g. PeerUnavailableError) so
             # callbacks finalize — a JSONLogger's file matters most there.
             cbs.on_train_end()
+        return history
+
+    # -- parameter-server worker path ----------------------------------------
+
+    def _build_ps_worker_step(self):
+        """The PS worker's compiled local step: ``(params, state, x, y, rng)
+        -> (loss, grads, state)`` — forward/backward ONLY. No optimizer
+        update (the server owns optimizer state) and no collective (the
+        strategy's mesh is one local device), which is the property
+        shardcheck pins for the ``ps_worker_step`` entry point."""
+        model, loss_obj = self.model, self.model.loss
+        device_transform = self._device_transform
+
+        def step(params, state, x, y, rng):
+            if device_transform is not None:
+                x = device_transform(x)
+
+            def loss_fn(p):
+                logits, new_state = model.apply(p, state, x, training=True,
+                                                rng=rng)
+                return loss_obj(logits, y) + _aux_loss_total(new_state), \
+                    new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, grads, new_state
+
+        return jax.jit(step)
+
+    def _fit_ps(self, x, *, epochs: int, steps_per_epoch: Optional[int],
+                verbose: int, callbacks: Sequence, initial_epoch: int,
+                seed: int) -> History:
+        """The worker side of async PS training: pull params (bounded
+        staleness enforced there), run ONE local step, push grads, repeat
+        until the server orders STOP or the local step budget runs out.
+
+        Epochless by nature — "epoch" here is local-step bookkeeping
+        (``local_step // steps_per_epoch``) so History/callbacks keep their
+        shape. The RNG stream is step-derived per (rank, local step)
+        (:func:`~tpu_dist.parallel.ps_strategy.worker_step_key`), NOT
+        epoch-derived: reproducibility is per-packet given the server's
+        apply-order log, not per-epoch. Checkpointing/validation stay
+        server-side; ``fit(checkpoint_dir=)`` is ignored here by design.
+        """
+        from tpu_dist.parallel import collectives
+        from tpu_dist.parallel.ps_strategy import worker_step_key
+        from tpu_dist.resilience.injector import (maybe_injector_from_env,
+                                                  maybe_preemption_drain)
+
+        strategy = self.strategy
+        dist = self._distribute(x)
+        self._sync_device_transform(dist, role="train")
+        if steps_per_epoch is None:
+            steps_per_epoch = self._cardinality_of(dist)
+            if steps_per_epoch is None:
+                raise ValueError(
+                    "steps_per_epoch is required for datasets of unknown "
+                    "cardinality (e.g. repeated/generator datasets)")
+        ps_step = self._acquire_program("ps_worker_step",
+                                        self._build_ps_worker_step,
+                                        self._transform_key(
+                                            self._device_transform))
+
+        callbacks = list(callbacks)
+        fault_injector = maybe_injector_from_env(
+            steps_per_epoch=steps_per_epoch)
+        if fault_injector is not None:
+            callbacks.append(fault_injector)
+        drain = maybe_preemption_drain()
+        if drain is not None:
+            callbacks.append(drain)
+        from tpu_dist.observe.telemetry import (Telemetry,
+                                                maybe_telemetry_from_env)
+
+        if not any(isinstance(cb, Telemetry) for cb in callbacks):
+            telemetry = maybe_telemetry_from_env()
+            if telemetry is not None:
+                callbacks.append(telemetry)
+
+        history = History()
+        cbs = CallbackList([history, *callbacks], model=self.model)
+        show = bool(verbose)
+        root_key = jax.random.PRNGKey(seed ^ 0x5EED)
+        params_template = self.variables["params"]
+        state = self.variables["state"]
+        rank = strategy.rank
+        # A worker caps at the GLOBAL step budget, not its 1/world share:
+        # under a straggler the fast workers must be free to cover the
+        # applies the slow one doesn't produce — the server's STOP (at its
+        # apply budget) is the real terminator.
+        max_local = (epochs - initial_epoch) * steps_per_epoch \
+            * max(1, strategy.num_workers)
+        local_step = 0
+        stopped = False
+        logger.info("PS worker %d: staleness=%d, steps_per_epoch=%d, "
+                    "local cap=%d", rank, strategy.staleness,
+                    steps_per_epoch, max_local)
+        cbs.on_train_begin()
+        try:
+            for epoch in range(initial_epoch, epochs * max(
+                    1, strategy.num_workers)):
+                cbs.on_epoch_begin(epoch)
+                if show:
+                    print(f"Epoch {epoch + 1}/{epochs} (PS worker {rank})")
+                bar = ProgressBar(steps_per_epoch, enabled=show)
+                loss_sum = 0.0
+                steps_this_epoch = 0
+                t_epoch = time.perf_counter()
+                for si in range(steps_per_epoch):
+                    pulled = strategy.pull(params_template)
+                    if pulled is None:  # server ordered STOP
+                        stopped = True
+                        break
+                    params, _version = pulled
+                    xb, yb = self._next_batch(dist)
+                    rng = worker_step_key(root_key, rank=rank,
+                                          local_step=local_step)
+                    loss, grads, state = ps_step(params, state, xb, yb, rng)
+                    # The straggler seam: a `delay@step*:rankN:always` plan
+                    # sleeps HERE, between compute and push — exactly where
+                    # a slow worker loses time. Same hook the sync stack's
+                    # collectives fire, so one fault grammar serves both
+                    # execution models.
+                    collectives.fire_fault_hook("ps_step")
+                    loss_val = float(loss)
+                    strategy.push(grads, loss=loss_val)
+                    strategy.heartbeat(step=local_step)
+                    local_step += 1
+                    steps_this_epoch += 1
+                    loss_sum += loss_val
+                    bar.update(si + 1, loss=loss_sum / steps_this_epoch)
+                    cbs.on_batch_end(si, {"loss": loss_val})
+                    if local_step >= max_local:
+                        stopped = True
+                        break
+                if steps_this_epoch:
+                    logs = {"loss": loss_sum / steps_this_epoch,
+                            "epoch_time": time.perf_counter() - t_epoch}
+                    bar.finish(logs)
+                    cbs.on_epoch_end(epoch, logs)
+                if stopped:
+                    break
+        except StopTraining as e:
+            logger.info("PS worker %d stopped early: %s", rank, e)
+        finally:
+            self._close_prefetcher()
+            strategy.mark_done(steps=local_step)
+            cbs.on_train_end()
+        logger.info("PS worker %d done: %d local steps, %d pushes",
+                    rank, local_step, strategy.pushed)
         return history
 
     def _integrity_rollback(self, rb, guard, checkpoint_dir, seed) -> int:
