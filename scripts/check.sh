@@ -3,7 +3,8 @@
 # the elastic preempt+reshape chaos run, the observe telemetry smoke/bench,
 # the checkpoint stall bench, the serve load bench, the step-execution
 # overlap bench, the parameter-server chaos smoke, the concurrency/liveness
-# analysis, then the tier-1 test suite.
+# analysis, the determinism/RNG-lineage analysis, then the tier-1
+# test suite.
 #
 # Usage: scripts/check.sh
 #
@@ -284,6 +285,27 @@ python -m tpu_dist.analysis --concurrency tpu_dist/ examples/ \
 conc_elapsed=$(( $(date +%s) - conc_start ))
 if [ "$conc_elapsed" -gt 30 ]; then
   echo "check.sh: analysis-concurrency took ${conc_elapsed}s" \
+    "(budget: 30s)" >&2
+  exit 1
+fi
+
+echo "== analysis-determinism: RNG lineage & exactness contracts =="
+# Pure-AST interprocedural pass sharing the concurrency Project infra:
+# SC601 nondet-source taint into seeds/persisted state, SC602 key reuse,
+# SC603 unordered iteration feeding order-sensitive work, SC604 fold-
+# constant collisions, SC605 float accumulation on exactness paths —
+# plus SC901 stale-suppression policing. (SC610, the jaxpr RNG-set
+# baseline, rides the analysis-cost stage above.) Same 30 s wall-clock
+# budget and failure contract as analysis-concurrency.
+det_start=$(date +%s)
+python -m tpu_dist.analysis --determinism tpu_dist/ examples/ \
+  --strict --format github \
+  || { echo "check.sh: determinism findings above" \
+       "(fix, or suppress on the finding line with a rationale)" >&2
+       exit 1; }
+det_elapsed=$(( $(date +%s) - det_start ))
+if [ "$det_elapsed" -gt 30 ]; then
+  echo "check.sh: analysis-determinism took ${det_elapsed}s" \
     "(budget: 30s)" >&2
   exit 1
 fi

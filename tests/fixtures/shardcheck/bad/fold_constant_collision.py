@@ -1,0 +1,16 @@
+"""BAD: two distinct derive domains fold the SAME constant into their
+streams -> SC604. A per-epoch fold and a per-job fold sharing 100003 can
+land on the same key for small coordinate pairs — each domain must own
+its own constant.
+"""
+import jax
+
+_FOLD = 100003
+
+
+def epoch_key(root_key, epoch):
+    return jax.random.fold_in(root_key, epoch * 100003)
+
+
+def derive_job_seed(name_digest, base_seed=0):
+    return (base_seed * _FOLD + name_digest) % (2 ** 31)

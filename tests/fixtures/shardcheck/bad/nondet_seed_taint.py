@@ -1,0 +1,38 @@
+"""BAD: wall-clock and unseeded-RNG values taint the exactness contracts
+-> SC601. Three flows, each through a different propagation edge:
+
+* ``time.time()`` -> local -> ``PRNGKey`` argument (direct assignment);
+* ``uuid4()`` -> helper return value -> checkpoint payload
+  (interprocedural returns-taint);
+* unseeded ``np.random.default_rng()`` -> ``seed=`` keyword.
+"""
+import json
+import time
+import uuid
+
+import jax
+import numpy as np
+
+
+def _fresh_tag():
+    return uuid.uuid4().hex
+
+
+def derive_key():
+    wallclock = int(time.time())
+    return jax.random.PRNGKey(wallclock)
+
+
+def write_checkpoint_meta(path):
+    tag = _fresh_tag()
+    payload = {"tag": tag, "step": 0}
+    with open(path, "w") as fh:
+        fh.write(json.dumps(payload))
+
+
+class Sampler:
+    def __init__(self):
+        pass
+
+    def build(self, make_dataset):
+        return make_dataset(seed=int(np.random.default_rng().integers(2**31)))

@@ -1,0 +1,20 @@
+"""BAD: the same PRNG key is consumed by two jax.random samplers with no
+interleaving split/fold_in -> SC602. Both the straight-line reuse and the
+loop-carried reuse (a loop-invariant key consumed every iteration) fire.
+"""
+import jax
+
+
+def double_draw(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # second consumption: same stream
+    return a + b
+
+
+def loop_draw(seed, n):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(n):
+        out.append(jax.random.normal(key, (4,)))  # same key every pass
+    return out

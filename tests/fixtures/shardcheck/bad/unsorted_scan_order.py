@@ -1,0 +1,24 @@
+"""BAD: unordered iteration feeds order-bearing state -> SC603. A glob
+scan appends to a sequence that is never sorted (the replay order then
+depends on readdir order), and a set iteration launches a collective
+(operand order must be rank-uniform, hash order is not).
+"""
+import os
+
+import jax
+
+
+def collect_packets(directory):
+    out = []
+    for name in os.listdir(directory):  # readdir order is arbitrary
+        out.append(name)
+    return out
+
+
+def reduce_shards(shards):
+    pending = set(shards)
+    total = None
+    for shard in pending:  # hash order differs across processes
+        part = jax.lax.psum(shard, "data")
+        total = part if total is None else total + part
+    return total

@@ -1,0 +1,24 @@
+"""shardcheck SC610 fixture: an entry point that CONSUMES RNG.
+
+Traced by ``cost`` as ``module:rng_entry``; the committed fixture
+baselines under ../baselines/ disagree about it on purpose:
+
+* ``rng_free.json`` records it with an empty RNG set — diffing against
+  that is the "contractually RNG-free step grew a random stream" SC610
+  error;
+* ``rng_recorded.json`` records the primitives it actually consumes —
+  diffing against that is clean.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _noisy_step(x, seed):
+    key = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+    return x + jax.random.normal(key, x.shape, dtype=x.dtype)
+
+
+def shardcheck_entry():
+    x = jnp.zeros((4, 4), dtype=jnp.float32)
+    return _noisy_step, (x, 3)

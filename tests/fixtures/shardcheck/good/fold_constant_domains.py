@@ -1,0 +1,15 @@
+"""GOOD: each derive domain folds its OWN constant -> no SC604. The
+epoch stream and the job stream use distinct primes, so no coordinate
+pair in one domain can reproduce a key from the other.
+"""
+import jax
+
+_JOB_FOLD = 1000003
+
+
+def epoch_key(root_key, epoch):
+    return jax.random.fold_in(root_key, epoch * 100003)
+
+
+def derive_job_seed(name_digest, base_seed=0):
+    return (base_seed * _JOB_FOLD + name_digest) % (2 ** 31)

@@ -1,0 +1,23 @@
+"""GOOD: every jax.random consumption uses a freshly derived key -> no
+SC602. Straight-line code splits between draws; the loop folds the step
+index in before each consumption.
+"""
+import jax
+
+
+def double_draw(seed):
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub = jax.random.split(key)
+    b = jax.random.uniform(sub, (4,))
+    return a + b
+
+
+def loop_draw(seed, n):
+    root = jax.random.PRNGKey(seed)
+    out = []
+    for i in range(n):
+        step_key = jax.random.fold_in(root, i)
+        out.append(jax.random.normal(step_key, (4,)))
+    return out

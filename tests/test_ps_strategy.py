@@ -94,6 +94,31 @@ class TestTransport:
         seen.update(p.name for p in order)
         assert psdir.scan_grads(seen=seen) == []
 
+    def test_scan_grads_equal_mtime_is_name_tiebroken(self, tmp_path):
+        """Property: when every packet shares one mtime_ns, discovery
+        order is the name sort — identical no matter which order the
+        files were created in. Equal-mtime ties happen for real on
+        coarse-clock filesystems; an unstable tiebreak there would make
+        the apply log depend on inode luck."""
+        names = [(0, 5), (1, 0), (2, 3), (1, 7), (0, 1)]
+        expected = None
+        rng = np.random.RandomState(11)
+        for trial in range(4):
+            d = tmp_path / f"trial{trial}"
+            psdir = PSDir(d).ensure()
+            order = rng.permutation(len(names))
+            for i in order:
+                rank, seq = names[i]
+                psdir.push_grad(_arrays(i), rank=rank, seq=seq, meta={})
+            ns = int(time.time() * 1e9)
+            for p in psdir.grads.iterdir():
+                os.utime(p, ns=(ns, ns))
+            got = [p.name for p in psdir.scan_grads(seen=set())]
+            assert got == sorted(got)
+            if expected is None:
+                expected = got
+            assert got == expected
+
     def test_apply_log_survives_torn_tail_and_rewrite(self, tmp_path):
         psdir = PSDir(tmp_path).ensure()
         for i in range(3):
@@ -299,6 +324,36 @@ class TestReplayReproducibility:
         assert replay["checksums"] == manifest["checksums"]
         assert replay["checksums"] == integrity.host_leaf_checksums(
             final_arrays)
+
+    def test_replay_is_invariant_to_on_disk_discovery_order(self, tmp_path):
+        """Property: replay follows the LOG, never directory enumeration —
+        scrambling every retained packet's mtime (the only thing scan
+        order keys on) between replays must leave the final checksums
+        bit-identical."""
+        model = _tiny_model()
+        psdir = PSDir(tmp_path / "ps").ensure()
+        params = model.init(0)["params"]
+        rng = np.random.RandomState(3)
+        budget = 5
+        for i in range(budget):
+            grads = jax.tree_util.tree_map(
+                lambda p: rng.normal(scale=0.1,
+                                     size=np.shape(p)).astype(np.float32),
+                params)
+            psdir.push_grad(tree_to_arrays(grads), rank=0, seq=i,
+                            meta={"base_version": i, "loss": 1.0})
+        server = PSServer(model, psdir, num_workers=1, budget=budget,
+                          seed=0, retain_grads=True)
+        server.run()
+        baseline = replay_apply_log(psdir, _tiny_model(), seed=0)
+        for trial in range(3):
+            shuffle = np.random.RandomState(trial).permutation(budget)
+            now = time.time()
+            for pos, p in zip(shuffle, sorted(psdir.grads.iterdir())):
+                ns = int((now - 60.0 * float(pos)) * 1e9)
+                os.utime(p, ns=(ns, ns))
+            replay = replay_apply_log(psdir, _tiny_model(), seed=0)
+            assert replay == baseline
 
     def test_replay_refuses_gced_packets(self, tmp_path):
         """GC'd packets cannot be replayed: the error names the retention

@@ -54,6 +54,11 @@ def _greedy_reference(model, variables, prompt, n):
 
 
 class TestKVCacheEquivalence:
+    # Tier-1 duration audit: ~23s of greedy full-forward reference decodes.
+    # The same cache-vs-full-forward contract stays in tier-1 one level up
+    # (TestServeEngine::test_continuous_batching_matches_full_forward) and
+    # check.sh's serve-bench gates token-identical streams on every push.
+    @pytest.mark.slow
     def test_incremental_decode_matches_full_forward(self):
         model, variables = _lm()
         plan = kv_cache.build_plan(model)
@@ -90,6 +95,11 @@ class TestKVCacheEquivalence:
         for i, (a, b) in enumerate(zip(got_logits, ref_logits)):
             np.testing.assert_allclose(a, b, atol=1e-5, err_msg=f"step {i}")
 
+    # Tier-1 duration audit: ~16s (128-pos interpret-mode flash compile).
+    # Kernel-vs-dense parity stays in tier-1 in test_flash_attention.py and
+    # prefill-vs-full-forward logits parity through the cache plumbing in
+    # test_serve_paging.py::test_suffix_prefill_matches_full_prefill_logits.
+    @pytest.mark.slow
     def test_flash_attention_prefill_matches(self):
         # interpret-mode flash needs L to be a whole tile (128): a 128-pos
         # model, prompt padded to 128. Decode then runs off the

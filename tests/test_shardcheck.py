@@ -58,6 +58,19 @@ BAD_CONCURRENCY = {
     "stale_suppression.py": {"SC901"},
 }
 
+#: Determinism fixtures (``--determinism`` mode): file -> exactly the
+#: rule IDs it must trip. Per-rule assertions live in
+#: test_shardcheck_determinism.py; this map feeds the advertised-rule
+#: coverage sweep below. SC610 is jaxpr-level and flags from the cost
+#: fixture vs baselines/rng_free.json instead.
+BAD_DETERMINISM = {
+    "nondet_seed_taint.py": {"SC601"},
+    "rng_key_reuse.py": {"SC602"},
+    "unsorted_scan_order.py": {"SC603"},
+    "fold_constant_collision.py": {"SC604"},
+    "unordered_float_sum.py": {"SC605"},
+}
+
 
 def _cli_json(capsys, argv):
     """Run the CLI in-process with --json; return (exit_code, payload)."""
@@ -253,6 +266,18 @@ class TestCliContract:
             _, payload = _cli_json(
                 capsys, [str(BAD / name), "--concurrency"])
             flagged |= _rule_ids(payload)
+        # SC6xx flag from the determinism fixture set...
+        for name in BAD_DETERMINISM:
+            _, payload = _cli_json(
+                capsys, [str(BAD / name), "--determinism"])
+            flagged |= _rule_ids(payload)
+        # ...except jaxpr-level SC610: the RNG-consuming cost fixture vs
+        # the baseline that records it RNG-free.
+        rc = cost_main([str(COST), "--entries", "module:rng_entry",
+                        "--baseline", str(BASELINES / "rng_free.json"),
+                        "--json"])
+        flagged |= _rule_ids(json.loads(capsys.readouterr().out))
+        assert rc == 1
         # SC900 is the degradation rule; its flagging fixture is synthetic
         # (test_unparseable_file_degrades_to_sc900) to keep bad/ all-error.
         assert advertised - {"SC900"} <= flagged
@@ -265,8 +290,18 @@ class TestCliContract:
                                          "--strict"])
         assert rc == 0
         assert payload["findings"] == []
+        rc, payload = _cli_json(capsys, [str(GOOD), "--determinism",
+                                         "--strict"])
+        assert rc == 0
+        assert payload["findings"] == []
         rc = cost_main(COST_FIXTURE_ARGS + [
             "--baseline", str(BASELINES / "cost_good.json"), "--strict"])
+        capsys.readouterr()
+        assert rc == 0
+        # The rng_recorded baseline matches the fixture's actual RNG set.
+        rc = cost_main([str(COST), "--entries", "module:rng_entry",
+                        "--baseline", str(BASELINES / "rng_recorded.json"),
+                        "--strict"])
         capsys.readouterr()
         assert rc == 0
 
@@ -502,6 +537,33 @@ class TestDogfood:
                 "pipeline_parallel.gpipe_schedule",
                 "pipeline_1f1b.one_f_one_b",
                 "training.trainer.train_step"} <= set(ENTRY_POINTS)
+
+    def test_baseline_and_entry_registry_are_one_to_one(self):
+        # The ROADMAP maintenance rule ("register every new traced entry
+        # point and re-run cost --update-baseline"), machine-enforced:
+        # jaxpr_checks.ENTRY_POINTS and ANALYSIS_BASELINE.json must agree
+        # exactly, both directions, names and count — and the SC610 rng
+        # section must cover the same names, so every entry point has a
+        # committed RNG-consumption contract.
+        from tpu_dist.analysis.jaxpr_checks import ENTRY_POINTS
+
+        baseline = json.loads((REPO / "ANALYSIS_BASELINE.json").read_text())
+        registered = set(ENTRY_POINTS)
+        committed = set(baseline["entries"])
+        assert registered - committed == set(), (
+            "entry points missing from ANALYSIS_BASELINE.json — run "
+            "`python -m tpu_dist.analysis cost --update-baseline` and "
+            "commit the diff")
+        assert committed - registered == set(), (
+            "stale ANALYSIS_BASELINE.json entries for unregistered entry "
+            "points — run `python -m tpu_dist.analysis cost "
+            "--update-baseline` and commit the diff")
+        assert len(ENTRY_POINTS) == len(baseline["entries"])
+        rng = baseline.get("rng")
+        assert rng is not None, (
+            "ANALYSIS_BASELINE.json has no 'rng' section — the SC610 "
+            "determinism gate has nothing to diff against")
+        assert set(rng) == committed
 
     def test_cost_matches_committed_baseline(self, capsys, eight_devices):
         # Acceptance criterion: every registered entry point's modeled

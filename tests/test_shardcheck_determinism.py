@@ -1,0 +1,438 @@
+"""shardcheck --determinism (SC6xx/SC901) tests: every rule over its
+bad/good fixture pair, the taint walk over the propagation edges the
+runtime actually uses (interprocedural returns, self-attribute stores,
+containers, loops/branches), the scan_grads exemption, SC900 degradation
+for untrackable taint, the --rules filter x mode x suppression
+interaction, the SC610 jaxpr companion, and the dogfooded strict run over
+the repo itself.
+
+Assertions are on rule IDs, never message text.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tpu_dist.analysis import determinism
+from tpu_dist.analysis.cli import cost_main, main as shardcheck_main
+from tpu_dist.analysis.rules import apply_suppressions
+
+from tests.test_shardcheck import (
+    BAD, BAD_DETERMINISM, BASELINES, COST, GOOD, PKG, _cli_json, _rule_ids)
+
+GOOD_DETERMINISM = [
+    "coordinate_derived_seed.py", "rng_key_split.py",
+    "sorted_scan_order.py", "fold_constant_domains.py",
+    "ordered_float_sum.py",
+]
+
+
+def _write(tmp_path, source, name="mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return f
+
+
+def _check(tmp_path, source, name="mod.py"):
+    """SC6xx findings (post-suppression) for one synthetic module."""
+    findings, project = determinism.check_paths(
+        [str(_write(tmp_path, source, name))])
+    src = {m.path: m.source_lines for m in project.modules.values()}
+    return apply_suppressions(findings, src)
+
+
+def _ids(findings):
+    return {f.rule_id for f in findings}
+
+
+class TestDeterminismRules:
+    @pytest.mark.parametrize("name,expected",
+                             sorted(BAD_DETERMINISM.items()))
+    def test_bad_fixture_flags_exactly_its_rule(self, capsys, name,
+                                                expected):
+        rc, payload = _cli_json(
+            capsys, [str(BAD / name), "--determinism", "--strict"])
+        assert rc == 1
+        assert _rule_ids(payload) == expected
+
+    @pytest.mark.parametrize("name", GOOD_DETERMINISM)
+    def test_good_fixture_is_clean(self, capsys, name):
+        rc, payload = _cli_json(
+            capsys, [str(GOOD / name), "--determinism", "--strict"])
+        assert rc == 0
+        assert payload["findings"] == []
+
+    def test_good_dir_clean_as_one_project(self, capsys):
+        rc, payload = _cli_json(
+            capsys, [str(GOOD), "--determinism", "--strict"])
+        assert rc == 0
+        assert payload["findings"] == []
+
+    def test_warning_rules_pass_without_strict(self, capsys):
+        # SC604 is a WARNING: advisory by default, fatal under --strict.
+        rc, payload = _cli_json(
+            capsys, [str(BAD / "fold_constant_collision.py"),
+                     "--determinism"])
+        assert rc == 0
+        assert "SC604" in _rule_ids(payload)
+
+
+class TestTaintWalk:
+    def test_interprocedural_return_taint(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import time
+            import jax
+
+            def stamp():
+                return time.time()
+
+            def derive():
+                return jax.random.PRNGKey(int(stamp()))
+            """)
+        assert _ids(findings) == {"SC601"}
+
+    def test_self_attr_taint_crosses_methods(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import json
+            import time
+
+            class Writer:
+                def stamp(self):
+                    self._t = time.time()
+
+                def write_checkpoint(self, fh):
+                    fh.write(json.dumps({"t": self._t}))
+            """)
+        assert _ids(findings) == {"SC601"}
+
+    def test_container_store_taints_payload(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import json
+            import uuid
+
+            def write_journal(fh):
+                payload = {}
+                payload["tag"] = uuid.uuid4().hex
+                fh.write(json.dumps(payload))
+            """)
+        assert _ids(findings) == {"SC601"}
+
+    def test_scan_grads_mtime_is_exempt(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import os
+
+            def scan_grads(directory):
+                out = []
+                for e in sorted(os.scandir(directory),
+                                key=lambda e: (e.stat().st_mtime_ns,
+                                               e.name)):
+                    out.append(e.name)
+                return out
+            """)
+        assert findings == []
+
+    def test_mtime_outside_scan_grads_flags(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import jax
+
+            def derive(entry):
+                return jax.random.PRNGKey(entry.stat().st_mtime_ns)
+            """)
+        assert _ids(findings) == {"SC601"}
+
+    def test_duration_clocks_are_not_sources(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import json
+            import time
+
+            def write_checkpoint_meta(fh, step):
+                t0 = time.perf_counter()
+                fh.write(json.dumps({"step": step}))
+                return time.perf_counter() - t0
+            """)
+        assert findings == []
+
+    def test_untrackable_store_degrades_to_sc900(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import time
+
+            def tag(other):
+                other.started = time.time()
+            """)
+        assert _ids(findings) == {"SC900"}
+
+    def test_coordinate_fold_chain_is_clean(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import jax
+
+            def step_key(base, epoch, step, rank):
+                key = jax.random.PRNGKey(base)
+                key = jax.random.fold_in(key, epoch)
+                key = jax.random.fold_in(key, step)
+                return jax.random.fold_in(key, rank)
+            """)
+        assert findings == []
+
+
+class TestKeyReuse:
+    def test_branch_consumption_merges_conservatively(self, tmp_path):
+        # Consumed in one if-arm, consumed again after the join -> reuse.
+        findings = _check(tmp_path, """\
+            import jax
+
+            def draw(key, flag):
+                if flag:
+                    a = jax.random.normal(key, (4,))
+                else:
+                    a = None
+                b = jax.random.uniform(key, (4,))
+                return a, b
+            """)
+        assert _ids(findings) == {"SC602"}
+
+    def test_rederive_in_both_arms_is_clean(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import jax
+
+            def draw(key, flag):
+                a = jax.random.normal(key, (4,))
+                if flag:
+                    key = jax.random.fold_in(key, 1)
+                else:
+                    key = jax.random.fold_in(key, 2)
+                return a + jax.random.uniform(key, (4,))
+            """)
+        assert findings == []
+
+    def test_loop_invariant_key_flags_on_second_pass(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import jax
+
+            def draw(key, n):
+                out = []
+                for _ in range(n):
+                    out.append(jax.random.normal(key, (4,)))
+                return out
+            """)
+        assert _ids(findings) == {"SC602"}
+
+    def test_fold_in_per_iteration_is_clean(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import jax
+
+            def draw(key, n):
+                out = []
+                for i in range(n):
+                    k = jax.random.fold_in(key, i)
+                    out.append(jax.random.normal(k, (4,)))
+                return out
+            """)
+        assert findings == []
+
+
+class TestUnorderedIteration:
+    def test_append_then_sorted_return_is_clean(self, tmp_path):
+        # checkpoint.all_steps' shape: arrival order erased by the sort.
+        findings = _check(tmp_path, """\
+            import os
+
+            def all_steps(d):
+                out = []
+                for name in os.listdir(d):
+                    out.append(name)
+                return sorted(out)
+            """)
+        assert findings == []
+
+    def test_unlink_only_body_is_clean(self, tmp_path):
+        findings = _check(tmp_path, """\
+            import os
+
+            def gc(d):
+                for name in os.listdir(d):
+                    os.remove(os.path.join(d, name))
+            """)
+        assert findings == []
+
+    def test_fold_threshold_ignores_small_constants(self, tmp_path):
+        # PRNGKey(0)/PRNGKey(42) at two sites is not a fold collision.
+        findings = _check(tmp_path, """\
+            import jax
+
+            def a():
+                return jax.random.PRNGKey(42)
+
+            def b():
+                return jax.random.PRNGKey(42)
+            """)
+        assert findings == []
+
+    def test_sc605_gated_to_exactness_paths(self, tmp_path):
+        # Same accumulation outside a checksum/replay/verify-named
+        # function: not SC605's business (SC603 decides on its own merits).
+        findings = _check(tmp_path, """\
+            import os
+
+            def total_bytes(d):
+                return sum(len(n) for n in os.listdir(d))
+            """)
+        assert findings == []
+
+
+class TestRulesFilterAndSuppression:
+    def test_rules_filter_narrows_mode(self, capsys):
+        # bad/ has SC601..SC605 findings; --rules keeps only the asked-for
+        # family (SC900/SC901 stay on by contract).
+        rc, payload = _cli_json(
+            capsys, [str(BAD), "--determinism", "--rules", "SC602",
+                     "--fail-on", "never"])
+        assert _rule_ids(payload) <= {"SC602", "SC900", "SC901"}
+        assert "SC602" in _rule_ids(payload)
+
+    def test_unknown_rule_id_is_a_cli_error(self, capsys):
+        with pytest.raises(SystemExit):
+            shardcheck_main([str(GOOD), "--determinism",
+                             "--rules", "SC999"])
+        capsys.readouterr()
+
+    def test_rules_filter_in_lint_mode(self, capsys):
+        # side_effect_in_jit trips SC103; narrowing to SC101 silences it.
+        rc, payload = _cli_json(
+            capsys, [str(BAD / "side_effect_in_jit.py"), "--no-trace",
+                     "--rules", "SC101"])
+        assert rc == 0
+        assert payload["findings"] == []
+
+    def test_deselected_suppression_is_not_judged_stale(self, capsys,
+                                                        tmp_path):
+        # A LIVE SC601 suppression must not be reported stale by a run
+        # that filtered SC601 out (it never looked for the finding).
+        f = _write(tmp_path, """\
+            import time
+            import jax
+
+            def derive():
+                return jax.random.PRNGKey(int(time.time()))  # shardcheck: disable=SC601 -- test fixture
+            """)
+        rc, payload = _cli_json(
+            capsys, [str(f), "--determinism", "--rules", "SC602",
+                     "--strict"])
+        assert rc == 0
+        assert payload["findings"] == []
+
+    def test_stale_suppression_flags_within_selection(self, capsys,
+                                                      tmp_path):
+        f = _write(tmp_path, """\
+            import jax
+
+            def derive(epoch):
+                return jax.random.fold_in(jax.random.PRNGKey(0), epoch)  # shardcheck: disable=SC601 -- nothing nondet here anymore
+            """)
+        rc, payload = _cli_json(
+            capsys, [str(f), "--determinism", "--rules", "SC601",
+                     "--strict"])
+        assert rc == 1
+        assert _rule_ids(payload) == {"SC901"}
+
+    def test_suppression_with_rationale_silences_sc6xx(self, capsys,
+                                                       tmp_path):
+        f = _write(tmp_path, """\
+            import time
+            import jax
+
+            def derive():
+                return jax.random.PRNGKey(int(time.time()))  # shardcheck: disable=SC601 -- test fixture
+            """)
+        rc, payload = _cli_json(
+            capsys, [str(f), "--determinism", "--strict"])
+        assert rc == 0
+        assert payload["findings"] == []
+
+    def test_list_rules_covers_sc6xx(self, capsys):
+        assert shardcheck_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rule in ("SC601", "SC602", "SC603", "SC604", "SC605", "SC610"):
+            assert rule in out
+        assert cost_main(["--list-rules"]) == 0
+        assert "SC610" in capsys.readouterr().out
+
+
+class TestRngBaseline:
+    def test_rng_primitives_detects_consumption(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_dist.analysis.jaxpr_checks import rng_primitives
+
+        def noisy(x):
+            key = jax.random.PRNGKey(0)
+            return x + jax.random.normal(key, x.shape)
+
+        def pure(x):
+            return x * 2.0
+
+        x = jnp.zeros((4,), jnp.float32)
+        assert rng_primitives(jax.make_jaxpr(noisy)(x)) != []
+        assert rng_primitives(jax.make_jaxpr(pure)(x)) == []
+
+    def test_rng_free_step_growing_rng_is_sc610(self):
+        from tpu_dist.analysis.jaxpr_checks import check_rng_baseline
+
+        findings = check_rng_baseline(
+            {"serve.decode_step": ["threefry2x32"]},
+            {"serve.decode_step": []}, "BASE")
+        assert [f.rule_id for f in findings] == ["SC610"]
+
+    def test_rng_set_drift_degrades_to_sc900(self):
+        from tpu_dist.analysis.jaxpr_checks import check_rng_baseline
+
+        findings = check_rng_baseline(
+            {"train_step": ["random_bits"]},
+            {"train_step": ["threefry2x32"]}, "BASE")
+        assert [f.rule_id for f in findings] == ["SC900"]
+
+    def test_unchanged_and_unknown_entries_are_quiet(self):
+        from tpu_dist.analysis.jaxpr_checks import check_rng_baseline
+
+        assert check_rng_baseline(
+            {"a": ["threefry2x32"], "new_entry": ["threefry2x32"]},
+            {"a": ["threefry2x32"]}, "BASE") == []
+
+    def test_update_baseline_records_rng_and_regates_clean(
+            self, capsys, tmp_path, eight_devices):
+        base = tmp_path / "baseline.json"
+        rc = cost_main([str(COST), "--entries", "module:rng_entry",
+                        "--update-baseline", "--baseline", str(base)])
+        capsys.readouterr()
+        assert rc == 0
+        data = json.loads(base.read_text())
+        assert data["rng"]["module:rng_entry"] != []
+        rc = cost_main([str(COST), "--entries", "module:rng_entry",
+                        "--baseline", str(base), "--strict"])
+        capsys.readouterr()
+        assert rc == 0
+        # Blanking the recorded set turns the same run into the SC610 gate.
+        data["rng"]["module:rng_entry"] = []
+        base.write_text(json.dumps(data))
+        rc = cost_main([str(COST), "--entries", "module:rng_entry",
+                        "--baseline", str(base), "--json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert "SC610" in _rule_ids(payload)
+
+
+class TestDogfoodDeterminism:
+    # check.sh's analysis-determinism stage runs the identical CLI in a
+    # fresh interpreter; the in-process copy here keeps tier-1 coverage
+    # without a second interpreter+import bill.
+    def test_repo_strict_determinism_is_clean(self, capsys):
+        repo = pathlib.Path(PKG).parent
+        rc, payload = _cli_json(
+            capsys, [str(PKG), str(repo / "examples"), "--determinism",
+                     "--strict"])
+        assert rc == 0, payload["findings"]
+        assert payload["findings"] == []

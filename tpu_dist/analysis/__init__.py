@@ -27,12 +27,25 @@ TPU-hour is spent:
   hard exit under lock) and liveness/protocol rules SC501-SC503
   (rank-divergent barrier, unbounded blocking wait, torn protocol-file
   write); the ``analysis-concurrency`` CI stage runs it strict;
+* :mod:`~tpu_dist.analysis.determinism` — the determinism/RNG-lineage
+  pass behind ``--determinism``, over the same call graph: SC601
+  (nondet source tainting RNG derivation or checkpoint/journal/apply-log
+  payloads, via a transitive interprocedural taint walk), SC602 (PRNG
+  key consumed twice without split/fold_in), SC603 (unsorted
+  listdir/glob/set iteration feeding durable state or collectives),
+  SC604 (two derive domains folding the same constant), SC605 (float
+  accumulation over unordered iterables in checksum/replay paths); the
+  SC610 jaxpr companion (per-entry-point RNG-consumption baselines in
+  ``ANALYSIS_BASELINE.json``) rides the ``cost`` pipeline; the
+  ``analysis-determinism`` CI stage runs it strict;
 * :mod:`~tpu_dist.analysis.rules` / :mod:`~tpu_dist.analysis.report` —
   the rule catalogue, suppressions and their SC901 staleness policing,
   text/JSON/GitHub-annotation output, exit-code policy;
 * :mod:`~tpu_dist.analysis.cli` — ``python -m tpu_dist.analysis [paths]``,
-  ``python -m tpu_dist.analysis --concurrency [paths]`` and
-  ``python -m tpu_dist.analysis cost``.
+  ``python -m tpu_dist.analysis --concurrency [paths]``,
+  ``python -m tpu_dist.analysis --determinism [paths]`` and
+  ``python -m tpu_dist.analysis cost``; every mode shares ``--rules``
+  (include filter) and ``--list-rules``.
 
 See README.md "Static analysis" for the CLI and rule catalogue;
 ``scripts/check.sh`` wires the checker and the cost gate in front of the

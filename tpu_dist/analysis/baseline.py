@@ -19,8 +19,17 @@ Schema (``tpu_dist.analysis/cost-v1``)::
           "peak_hbm_bytes": 5678,
           "hbm_budget_bytes": 11356   # 2x measured peak at update time
         }
+      },
+      "rng": {                        # optional; SC610 determinism gate
+        "<entry>": []                 # RNG primitive names consumed
       }
     }
+
+The ``rng`` section (added by shardcheck v4) is optional and lives
+BESIDE ``entries`` so adding it leaves every pre-existing entry
+bit-identical: an entry recorded as ``[]`` is contractually RNG-free
+and growing a random primitive is an SC610 error
+(:func:`tpu_dist.analysis.jaxpr_checks.check_rng_baseline`).
 """
 
 from __future__ import annotations
@@ -52,10 +61,13 @@ def load(path: str) -> dict:
 
 def build(reports: Mapping, *, mesh: Mapping,
           tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
-          previous: dict | None = None) -> dict:
+          previous: dict | None = None,
+          rng: Mapping | None = None) -> dict:
     """Baseline dict from ``{entry: CostReport}``. HBM budgets are carried
     over from ``previous`` when they still cover the measured peak, else
-    re-granted at ``HBM_BUDGET_FACTOR`` x the new peak."""
+    re-granted at ``HBM_BUDGET_FACTOR`` x the new peak. ``rng`` maps
+    entry -> sorted RNG primitive names (SC610); when None the previous
+    baseline's section is carried forward unchanged."""
     prev_entries = (previous or {}).get("entries", {})
     entries = {}
     for name in sorted(reports):
@@ -70,12 +82,17 @@ def build(reports: Mapping, *, mesh: Mapping,
             "peak_hbm_bytes": r.peak_hbm_bytes,
             "hbm_budget_bytes": budget,
         }
-    return {
+    data = {
         "schema": SCHEMA,
         "mesh": {k: int(v) for k, v in dict(mesh).items()},
         "tolerance_pct": float(tolerance_pct),
         "entries": entries,
     }
+    if rng is None:
+        rng = (previous or {}).get("rng")
+    if rng is not None:
+        data["rng"] = {name: sorted(rng[name]) for name in sorted(rng)}
+    return data
 
 
 def write(path: str, data: dict) -> None:

@@ -16,6 +16,12 @@ error; ``--strict`` lowers it to warning), 0 otherwise — the contract
 ``scripts/check.sh`` builds on. ``--format github`` renders findings as
 workflow annotations (``::error file=…,line=…::``).
 
+``--concurrency`` (SC4xx/SC5xx) and ``--determinism`` (SC6xx) each swap
+in a pure-AST interprocedural pass over a shared call-graph Project —
+no imports, no backend. ``--rules SC601,SC603`` narrows any mode to the
+listed rules (SC900/SC901 always ride along); ``--list-rules`` prints
+the catalogue.
+
 ``cost`` mode prices the same traces instead of rule-checking them: per
 entry point, modeled communication volume and peak live-buffer bytes
 (costmodel.py), optionally diffed against a committed baseline
@@ -35,6 +41,7 @@ from typing import Optional
 
 from tpu_dist.analysis import ast_lint, report
 from tpu_dist.analysis.rules import (
+    RULES,
     Finding,
     apply_suppressions,
     stale_suppressions,
@@ -47,6 +54,40 @@ from tpu_dist.analysis.rules import (
 _AST_RULE_IDS = frozenset({"SC101", "SC102", "SC103", "SC104", "SC105"})
 _CONCURRENCY_RULE_IDS = frozenset({
     "SC401", "SC402", "SC403", "SC404", "SC501", "SC502", "SC503"})
+_DETERMINISM_RULE_IDS = frozenset({
+    "SC601", "SC602", "SC603", "SC604", "SC605"})
+
+
+def _add_rules_arg(parser) -> None:
+    parser.add_argument(
+        "--rules", default=None, metavar="SCnnn[,SCnnn...]",
+        help="run only these rule IDs (e.g. --rules SC601,SC603); "
+             "SC900 degradation and SC901 staleness reporting always "
+             "stay on")
+
+
+def _parse_rules(parser, spec: Optional[str]) -> Optional[frozenset]:
+    """Validated ``--rules`` selection, or None for 'all rules'."""
+    if spec is None:
+        return None
+    selected = {part.strip() for part in spec.split(",") if part.strip()}
+    unknown = sorted(r for r in selected if r not in RULES)
+    if unknown:
+        parser.error(f"unknown rule ID(s): {', '.join(unknown)}; "
+                     f"see --list-rules")
+    if not selected:
+        parser.error("--rules given but no rule IDs parsed")
+    return frozenset(selected)
+
+
+def _filter_rules(findings, selected: Optional[frozenset]) -> list:
+    """Keep only selected rules. SC900 (degradation) and SC901 (stale
+    suppressions) are never filtered out: a narrowed run must still be
+    honest about what it could not analyze."""
+    if selected is None:
+        return list(findings)
+    keep = set(selected) | {"SC900", "SC901"}
+    return [f for f in findings if f.rule_id in keep]
 
 
 def _force_cpu_backend() -> None:
@@ -132,22 +173,56 @@ def _render(findings, *, fmt: str, paths=(), fail_on: str) -> None:
         report.render_text(findings, paths=paths)
 
 
-def _concurrency_check(paths) -> list[Finding]:
-    """``--concurrency`` mode: SC4xx thread-safety + SC5xx liveness over
-    the interprocedural host call graph, then SC901 staleness for the
-    suppressions those rules own. Pure AST — no imports, no backend."""
-    from tpu_dist.analysis import concurrency, liveness
+def _project_mode_check(paths, checkers, mode_rule_ids,
+                        selected: Optional[frozenset]) -> list[Finding]:
+    """Shared driver for the project-graph modes (--concurrency,
+    --determinism): build the call graph once, run the mode's checkers,
+    apply suppressions, then SC901 staleness scoped to the rules this
+    run actually evaluated (mode ∩ --rules selection — a suppression for
+    a deselected rule cannot be proven stale by a run that never looked
+    for its finding)."""
+    from tpu_dist.analysis import concurrency
 
     project = concurrency.build_project(paths)
-    raw = concurrency.check_project(project)
-    raw.extend(liveness.check_project(project))
+    raw: list[Finding] = []
+    for check in checkers:
+        raw.extend(check(project))
+    raw = _filter_rules(raw, selected)
     source_by_path = {m.path: m.source_lines
                       for m in project.modules.values()}
+    evaluated = (mode_rule_ids if selected is None
+                 else mode_rule_ids & selected)
     findings = apply_suppressions(raw, source_by_path)
     findings.extend(apply_suppressions(
-        stale_suppressions(raw, source_by_path, _CONCURRENCY_RULE_IDS),
+        stale_suppressions(raw, source_by_path, evaluated),
         source_by_path))
     return findings
+
+
+def _concurrency_check(paths,
+                       selected: Optional[frozenset] = None
+                       ) -> list[Finding]:
+    """``--concurrency`` mode: SC4xx thread-safety + SC5xx liveness over
+    the interprocedural host call graph. Pure AST — no imports, no
+    backend."""
+    from tpu_dist.analysis import concurrency, liveness
+
+    return _project_mode_check(
+        paths, [concurrency.check_project, liveness.check_project],
+        _CONCURRENCY_RULE_IDS, selected)
+
+
+def _determinism_check(paths,
+                       selected: Optional[frozenset] = None
+                       ) -> list[Finding]:
+    """``--determinism`` mode: SC6xx determinism/RNG-lineage rules over
+    the same host call graph. Pure AST — the jaxpr half of the family
+    (SC610) rides the `cost` subcommand, which already traces."""
+    from tpu_dist.analysis import determinism
+
+    return _project_mode_check(
+        paths, [determinism.check_project],
+        _DETERMINISM_RULE_IDS, selected)
 
 
 def main(argv: Optional[list] = None) -> int:
@@ -183,6 +258,12 @@ def main(argv: Optional[list] = None) -> int:
              "(SC4xx/SC5xx + SC901) instead of the sharding lint; pure "
              "AST, no backend")
     parser.add_argument(
+        "--determinism", action="store_true",
+        help="run the determinism/RNG-lineage analyzer (SC6xx + SC901) "
+             "instead of the sharding lint; pure AST, no backend (the "
+             "SC610 jaxpr companion runs under the `cost` subcommand)")
+    _add_rules_arg(parser)
+    parser.add_argument(
         "--fail-on", default="error",
         choices=("error", "warning", "info", "never"),
         help="lowest severity that makes the exit code non-zero "
@@ -201,21 +282,32 @@ def main(argv: Optional[list] = None) -> int:
 
     fmt = args.format or ("json" if args.json else "text")
     fail_on = "warning" if args.strict else args.fail_on
+    selected = _parse_rules(parser, args.rules)
 
     paths = args.paths or _default_paths()
     for p in paths:
         if not os.path.exists(p):
             parser.error(f"no such path: {p}")
 
+    if args.concurrency and args.determinism:
+        parser.error("--concurrency and --determinism are separate "
+                     "modes; run them as two invocations")
     if args.concurrency:
-        findings = _concurrency_check(paths)
+        findings = _concurrency_check(paths, selected)
+        _render(findings, fmt=fmt, paths=paths, fail_on=fail_on)
+        return report.exit_code(findings, fail_on=fail_on)
+    if args.determinism:
+        findings = _determinism_check(paths, selected)
         _render(findings, fmt=fmt, paths=paths, fail_on=fail_on)
         return report.exit_code(findings, fail_on=fail_on)
 
     raw, source_by_path = ast_lint.lint_paths_raw(paths)
+    raw = _filter_rules(raw, selected)
+    evaluated = (_AST_RULE_IDS if selected is None
+                 else _AST_RULE_IDS & selected)
     findings = apply_suppressions(raw, source_by_path)
     findings.extend(apply_suppressions(
-        stale_suppressions(raw, source_by_path, _AST_RULE_IDS),
+        stale_suppressions(raw, source_by_path, evaluated),
         source_by_path))
 
     if not args.no_trace:
@@ -227,11 +319,13 @@ def main(argv: Optional[list] = None) -> int:
         # contains) tpu_dist itself — the dogfooded self-check.
         if any(os.sep + "tpu_dist" + os.sep in os.path.abspath(f) + os.sep
                or os.path.basename(f) == "trainer.py" for f in files):
-            findings.extend(jaxpr_checks.run_entry_points())
+            findings.extend(_filter_rules(
+                jaxpr_checks.run_entry_points(), selected))
         trace_findings = []
         for f in files:
             if _has_shardcheck_entry(f):
                 trace_findings.extend(_check_module_entry(f))
+        trace_findings = _filter_rules(trace_findings, selected)
         source_by_path = {}
         for f in {t.path for t in trace_findings if os.path.exists(t.path)}:
             with open(f, "r", encoding="utf-8") as fh:
@@ -308,10 +402,19 @@ def cost_main(argv: Optional[list] = None) -> int:
     parser.add_argument(
         "--strict", action="store_true",
         help="fail on warnings (SC302) too, not just SC301 errors")
+    _add_rules_arg(parser)
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalogue and exit")
     args = parser.parse_args(argv)
+
+    if args.list_rules:
+        report.render_rules()
+        return 0
 
     fmt = args.format or ("json" if args.json else "text")
     fail_on = "warning" if args.strict else "error"
+    selected = _parse_rules(parser, args.rules)
     baseline_path = args.baseline or "ANALYSIS_BASELINE.json"
 
     if args.calibrate:
@@ -380,6 +483,10 @@ def cost_main(argv: Optional[list] = None) -> int:
             closed, entry=name, model_mesh=model_mesh, links=links,
             flops_per_s=flops_per_s)
         for name, closed in traced.items()}
+    # SC610 rides the cost pipeline: the traces are already in hand, so
+    # the RNG-consumption sets are free to record/diff here.
+    rng_now = {name: jaxpr_checks.rng_primitives(closed)
+               for name, closed in traced.items()}
 
     for p in args.paths:
         for f in ast_lint.iter_python_files([p]):
@@ -396,6 +503,7 @@ def cost_main(argv: Optional[list] = None) -> int:
                 reports[label] = costmodel.analyze_jaxpr(
                     closed, entry=label, model_mesh=model_mesh, links=links,
                     flops_per_s=flops_per_s)
+                rng_now[label] = jaxpr_checks.rng_primitives(closed)
             except Exception as e:  # noqa: BLE001 - degrade, never crash
                 findings.append(Finding(
                     "SC900", f, 1, 0,
@@ -407,7 +515,8 @@ def cost_main(argv: Optional[list] = None) -> int:
                else (previous or {}).get(
                    "tolerance_pct", baseline_lib.DEFAULT_TOLERANCE_PCT))
         data = baseline_lib.build(
-            reports, mesh=model_mesh, tolerance_pct=tol, previous=previous)
+            reports, mesh=model_mesh, tolerance_pct=tol, previous=previous,
+            rng=rng_now)
         baseline_lib.write(baseline_path, data)
         print(f"wrote {baseline_path}: {len(reports)} entry point(s), "
               f"mesh {model_mesh or '(as traced)'}, "
@@ -420,7 +529,10 @@ def cost_main(argv: Optional[list] = None) -> int:
         findings.extend(baseline_lib.compare(
             reports, previous, tolerance_pct=args.tolerance,
             path=baseline_path))
+        findings.extend(jaxpr_checks.check_rng_baseline(
+            rng_now, previous.get("rng", {}), baseline_path))
 
+    findings = _filter_rules(findings, selected)
     if fmt == "json":
         report.dump_json(report.to_cost_json(
             reports, findings, mesh=model_mesh,

@@ -88,6 +88,65 @@ def _inner_jaxprs(params: dict):
                 yield jaxpr
 
 
+#: Primitive-name fragments that identify RNG consumption in a jaxpr
+#: (SC610). Substring match for the same rename-robustness reason as
+#: _COLLECTIVE_FRAGMENTS: threefry2x32 / threefry_2x32 / random_seed /
+#: random_bits / random_fold_in / rng_bit_generator all count.
+_RNG_FRAGMENTS = ("threefry", "random_seed", "random_bits", "random_fold",
+                  "random_gamma", "random_wrap", "random_unwrap",
+                  "rng_bit_generator", "rng_uniform")
+
+
+def rng_primitives(jaxpr) -> list[str]:
+    """Sorted, de-duplicated RNG primitive names a jaxpr consumes,
+    descending into every sub-jaxpr. An empty list is a CONTRACT for the
+    RNG-free entry points (serve decode/prefill, audit checksums, the PS
+    server apply): their whole exactness story assumes no stream is
+    consumed inside the step."""
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    out: set = set()
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if any(f in name for f in _RNG_FRAGMENTS):
+            out.add(name)
+        for sub in _inner_jaxprs(eqn.params):
+            out.update(rng_primitives(sub))
+    return sorted(out)
+
+
+def check_rng_baseline(rng_now: dict, rng_baseline: dict,
+                       path: str) -> list:
+    """SC610: a traced entry point whose committed baseline records ZERO
+    RNG primitives now consumes one — the exactness contract for that
+    step just silently broke. Drift in already-RNG-consuming entries
+    (new primitive name, jax rename) degrades to SC900 info with the
+    re-baseline hint, never an error: intended randomness is re-baselined,
+    contractually-absent randomness is a gate."""
+    findings: list[Finding] = []
+    for name in sorted(rng_now):
+        if name not in rng_baseline:
+            continue  # new entries are covered at --update-baseline time
+        before, after = list(rng_baseline[name]), list(rng_now[name])
+        if before == after:
+            continue
+        if not before and after:
+            findings.append(Finding(
+                "SC610", path, 1, 0,
+                f"{name}: baseline records this step as RNG-FREE, but it "
+                f"now consumes {', '.join(after)}; a contractually "
+                f"deterministic step (replay/verify compares its bits) "
+                f"grew a random stream — remove it, or re-run cost "
+                f"--update-baseline only if the contract itself changed"))
+        else:
+            findings.append(Finding(
+                "SC900", path, 1, 0,
+                f"{name}: RNG primitive set drifted from baseline "
+                f"({', '.join(before) or 'none'} -> "
+                f"{', '.join(after) or 'none'}); if intended, re-run "
+                f"cost --update-baseline and commit the diff"))
+    return findings
+
+
 def _collective_uses(jaxpr) -> list:
     """Depth-first ``(name, axes, shape, dtype)`` tuples for every
     collective launch a jaxpr issues (program launch order for

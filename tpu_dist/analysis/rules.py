@@ -52,7 +52,10 @@ class Rule:
 #: jaxpr-level rules (jaxpr_checks.py); SC3xx are cost/baseline rules
 #: (costmodel.py/baseline.py); SC4xx are host-runtime thread-safety rules
 #: and SC5xx liveness/protocol rules (concurrency.py/liveness.py, the
-#: ``--concurrency`` mode); SC901 polices the suppressions themselves.
+#: ``--concurrency`` mode); SC6xx are determinism/RNG-lineage rules
+#: (determinism.py, the ``--determinism`` mode, plus the SC610 jaxpr
+#: companion in jaxpr_checks.py); SC901 polices the suppressions
+#: themselves.
 RULES = {r.id: r for r in (
     Rule(
         "SC101", "unknown-collective-axis", Severity.ERROR,
@@ -189,6 +192,56 @@ RULES = {r.id: r for r in (
         "truncated or half-written payload mid-write; write to a tmp "
         "name in the same directory and os.replace it into place so "
         "publication is atomic."),
+    Rule(
+        "SC601", "nondet-source-taints-state", Severity.ERROR,
+        "A nondeterministic value (wall-clock time.time/datetime.now, "
+        "uuid1/uuid4, os.urandom, unseeded stdlib/np.random) flows — "
+        "through the transitive assignment/call taint walk — into RNG "
+        "key derivation (PRNGKey/fold_in/seed=), a checkpoint/journal/"
+        "apply-log payload, or a protocol-file name used for ordering. "
+        "One such value silently converts 'bit-exact replay' into "
+        "'usually replays'. Coordinate-derived folds (epoch/step/rank) "
+        "are the contract; mtime read back inside scan_grads is exempt "
+        "(arrival order is the documented PS contract)."),
+    Rule(
+        "SC602", "rng-key-reuse", Severity.ERROR,
+        "The same PRNG key expression is consumed by two jax.random "
+        "sampler calls with no interleaving split/fold_in re-derivation. "
+        "Reused keys make 'independent' draws identical — losses look "
+        "plausible, statistics are silently wrong. Split the key, or "
+        "fold a coordinate in between consumptions."),
+    Rule(
+        "SC603", "unordered-iteration-feeds-order", Severity.ERROR,
+        "A loop over an unordered iterable (os.listdir/glob/scandir/"
+        "iterdir, a set) whose body writes durable state, appends to a "
+        "sequence that is never sorted, or launches collectives. "
+        "Filesystem enumeration order is arbitrary; state derived from "
+        "it differs run to run and rank to rank. Wrap the iterable in "
+        "sorted(), or prove order-insensitivity (pure set/count/unlink "
+        "bodies are not flagged)."),
+    Rule(
+        "SC604", "fold-constant-collision", Severity.WARNING,
+        "Two distinct seed-derivation sites fold an identical constant "
+        "into their streams. Derivations sharing a fold constant can "
+        "collide (job A's seed arithmetic landing on job B's epoch "
+        "stream), correlating 'independent' RNG streams. Give each "
+        "derive domain its own constant."),
+    Rule(
+        "SC605", "float-accumulation-over-unordered", Severity.WARNING,
+        "A float reduction (sum()/+= in a loop) over an unordered "
+        "iterable inside a checksum/replay/verify/audit path. Float "
+        "addition is not associative, so accumulation order changes the "
+        "bits — exactly where bit-identity is the contract. Sort the "
+        "iterable or use an order-insensitive (integer) accumulator."),
+    Rule(
+        "SC610", "rng-consumption-regression", Severity.ERROR,
+        "A traced entry point whose committed baseline records ZERO RNG "
+        "primitives (serve decode/prefill, audit checksums, the PS "
+        "server apply — the contractually RNG-free steps) now consumes "
+        "one. Randomness sneaking into an RNG-free program breaks "
+        "replay/token-identity gates at the program level. Intended "
+        "randomness: re-run cost --update-baseline and commit the "
+        "diff."),
     Rule(
         "SC901", "stale-suppression", Severity.WARNING,
         "A `# shardcheck: disable=SCnnn` comment that suppresses "

@@ -408,14 +408,14 @@ def try_promote_to_device(ds: Dataset):
         scale_op, scale = detected
     from tpu_dist.data.device import DeviceDataset
 
-    out = DeviceDataset(
+    out = DeviceDataset(  # shardcheck: disable=SC601 -- chain declared an UNSEEDED shuffle (seed-None guard above); a random seed IS that contract
         images, labels, global_batch_size=batch,
         seed=int(np.random.default_rng().integers(2**31)),
         shuffle=shuffle, scale=scale, scale_op=scale_op)
     logger.info("vectorize: promoted %d-element chain to device residency "
                 "(%.1f MB uploaded once, index-only steps)", n,
                 images.nbytes / 1e6)
-    ds._device_promoted = out
+    ds._device_promoted = out  # shardcheck: disable=SC900 -- promotion cache attribute, never persisted; taint ends here
     return out
 
 

@@ -51,10 +51,11 @@ KINDS = ("train", "serve")
 
 _NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
 
-#: The trainer's epoch fold-in constant (training/trainer.py) — reused so
-#: the job fold composes with, but never collides into, the per-epoch
-#: stream: epochs fold small ints, jobs fold a 32-bit name digest.
-_FOLD = 100003
+#: The job-domain fold constant. Deliberately DISTINCT from the
+#: trainer's per-epoch fold (100003 in training/trainer.py): two derive
+#: domains sharing a multiplier can land on the same stream for small
+#: coordinate pairs (SC604). Each domain owns its own prime.
+_FOLD = 1000003
 
 
 def derive_job_seed(name: str, base_seed: int = 0) -> int:

@@ -477,10 +477,12 @@ class PSServer:
         lag = max(0.0, time.time() - float(meta.get("time", time.time())))
         metrics.observe_value("ps.apply_lag", lag)
         metrics.inc("ps.applies")
+        # The apply log is the bit-exact replay contract: coordinates
+        # only, never wall-clock (lag lives in the ps.apply_lag metric).
         self.psdir.append_apply_log({
             "apply": self.applies, "rank": rank, "seq": int(meta["seq"]),
             "base_version": meta.get("base_version"),
-            "loss": meta.get("loss"), "lag_s": round(lag, 6),
+            "loss": meta.get("loss"),
         })
         if self.applies % self.checksum_every == 0:
             jax.block_until_ready(new_params)

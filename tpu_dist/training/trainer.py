@@ -1013,7 +1013,7 @@ class Trainer:
         history = History()
         cbs = CallbackList([history, *callbacks], model=self.model)
         show = bool(verbose)
-        root_key = jax.random.PRNGKey(seed ^ 0x5EED)
+        root_key = jax.random.PRNGKey(seed ^ 0x5EED)  # shardcheck: disable=SC604 -- deliberately mirrors fit()'s root-key derivation so the PS sync control is stream-identical to the sync trainer
         params_template = self.variables["params"]
         state = self.variables["state"]
         rank = strategy.rank
