@@ -112,7 +112,7 @@ timeout -k 10 300 env JAX_PLATFORMS=cpu python benchmarks/checkpoint_bench.py \
   || { echo "check.sh: checkpoint bench gates failed" \
        "(see BENCH_CHECKPOINT.json)" >&2; exit 1; }
 
-echo "== serve-bench: batching policies + paged KV + chunked prefill =="
+echo "== serve-bench: batching + paged KV + chunked prefill + int8/ragged =="
 # Drives the identical seeded backlog through a continuous-batching and a
 # static-batching ServeEngine (warmup pass compiles every bucket first);
 # writes BENCH_SERVE.json. Gates: every request completed in BOTH modes
@@ -126,7 +126,15 @@ echo "== serve-bench: batching policies + paged KV + chunked prefill =="
 # mid-stream long prompts through a chunked (prefill_chunk=32) and an
 # unchunked engine must all complete with token-identical streams, and
 # the chunked decode p99 inter-token gap must stay <= 0.5x unchunked
-# (chunking ends the long-prefill head-of-line stall).
+# (chunking ends the long-prefill head-of-line stall); PLUS the quant
+# dimension — at the same byte budget an int8 pool must hold >= 1.8x
+# the bf16 pool's pages AND measured peak concurrency, match bf16
+# greedy streams modulo certified fp32 near-ties, and keep forced-
+# horizon logit drift bounded; PLUS the ragged dimension — the ragged
+# engine must stream token-identically to the bucketed control from
+# exactly ONE decode program (jit cache pinned at one entry across a
+# steady-state repeat) while the control compiles a bucket family; the
+# prefix-TTFT and chunked-p99 gates are then re-run under int8+ragged.
 timeout -k 10 420 env JAX_PLATFORMS=cpu python benchmarks/serve_bench.py \
   >/dev/null \
   || { echo "check.sh: serve bench gates failed (see BENCH_SERVE.json)" >&2

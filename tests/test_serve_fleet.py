@@ -61,8 +61,9 @@ def _sessioned_workload(sessions=3, visits=3, *, seed=0):
     return out
 
 
-def _solo_streams(model, workload):
-    solo = ServeEngine(model, max_batch=4, max_len=32, seed=0)
+def _solo_streams(model, workload, **engine_kwargs):
+    solo = ServeEngine(model, max_batch=4, max_len=32, seed=0,
+                       **engine_kwargs)
     reqs = [solo.submit(p, max_new_tokens=n) for p, n in workload]
     solo.run_until_idle()
     programs = solo.compiled_programs()
@@ -338,6 +339,52 @@ class TestFleetFailover:
         base = solo.submit(prompt, max_new_tokens=6)
         solo.run_until_idle()
         solo.close()
+        assert adopted.status == DONE
+        assert list(adopted.generated) == list(base.generated)
+
+    def test_int8_ragged_replicas_smoke(self, model, tmp_path):
+        """The factory seam carries ``kv_dtype``/``ragged`` untouched: a
+        fleet of int8 ragged paged replicas must stream bit-identically
+        to a solo engine in the same configuration, surviving a kill +
+        journal failover along the way."""
+        quant_kw = dict(paged=True, page_size=PAGE, kv_dtype="int8",
+                        ragged=True)
+        workload = _sessioned_workload(sessions=2, visits=3)
+        baseline, _ = _solo_streams(model, workload, **quant_kw)
+        plan = FaultPlan.parse("replica_kill@req1:replica0")
+        fleet = ServeFleet(_factory(model, **quant_kw), replicas=2,
+                           page_size=PAGE, plan=plan,
+                           journal_root=str(tmp_path))
+        fleet.start()
+        frs = [fleet.submit(p, max_new_tokens=n) for p, n in workload]
+        fleet.drain(timeout_s=120.0)
+        fleet.close()
+        assert [d["replica"] for d in fleet.deaths] == [0]
+        assert fleet.failover_replayed >= 1
+        assert all(fr.status == DONE for fr in frs)
+        assert [fr.tokens for fr in frs] == baseline
+
+    def test_adopt_request_reprefills_int8_midstream(self, model):
+        """Failover migration onto an int8 survivor: ``adopt_request``
+        carries tokens, never pool bytes, so the survivor re-prefills —
+        and re-quantizes — prompt + partial stream from scratch. Per-
+        position scaling makes those bytes independent of the donor's
+        write history, so the resumed stream must match a solo int8 run
+        bit-for-bit."""
+        quant_kw = dict(paged=True, page_size=PAGE, kv_dtype="int8")
+        prompt = list(range(1, 11))
+        solo = ServeEngine(model, max_batch=4, max_len=32, seed=0,
+                           **quant_kw)
+        base = solo.submit(prompt, max_new_tokens=6)
+        solo.run_until_idle()
+        solo.close()
+        partial = list(base.generated)[:3]
+        survivor = ServeEngine(model, max_batch=4, max_len=32, seed=0,
+                               **quant_kw)
+        adopted = survivor.adopt_request(prompt, generated=partial,
+                                         max_new_tokens=6)
+        survivor.run_until_idle()
+        survivor.close()
         assert adopted.status == DONE
         assert list(adopted.generated) == list(base.generated)
 

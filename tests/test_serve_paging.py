@@ -3,8 +3,11 @@ allocator free-list/refcount/reservation invariants, loud exhaustion and
 budget errors, paged-vs-contiguous numerical equivalence (allclose logits
 AND bit-identical greedy streams), prefix-cache hits with copy-on-write
 divergence, free-page-headroom admission (FIFO deferral instead of
-deadlock), host pointer-swap compaction, observe metrics, and the
-shardcheck baseline pins for the two paged entry points.
+deadlock), host pointer-swap compaction, observe metrics, the
+shardcheck baseline pins for the paged entry points, int8 quantized
+pools (sizing ratio, stream parity, COW scale rows, quant-error
+metric), and ragged single-program decode (parity with the bucketed
+engine, one compiled program, no steady-state retrace).
 """
 
 import numpy as np
@@ -428,3 +431,160 @@ class TestPagedShardcheck:
             # strategy, exactly like the contiguous path it replaces.
             assert base["entries"][name]["total_comm_bytes"] == 0
             assert base["entries"][name]["peak_hbm_bytes"] > 0
+
+
+class TestInt8KV:
+    def _plan64(self):
+        # key_dim 64: the fp32 scale rows amortize over the head dim and
+        # the int8 page lands at ~1.89x bf16 density (the bench's gate).
+        model = build_transformer_lm(VOCAB, 16, d_model=128, depth=1,
+                                     num_heads=2)
+        model.init(0)
+        return kv_cache.build_plan(model)
+
+    def test_page_sizing_counts_scale_rows(self):
+        plan = self._plan64()
+        i8 = kv_cache.page_nbytes(plan, page_size=8, dtype=jnp.int8)
+        bf = kv_cache.page_nbytes(plan, page_size=8, dtype=jnp.bfloat16)
+        payload = 2 * plan.num_layers * plan.num_heads * 8 * plan.key_dim
+        scales = 2 * plan.num_layers * plan.num_heads * 8 * 4
+        assert i8 == payload + scales
+        assert bf / i8 >= 1.8  # the capacity claim, statically
+        budget = 64 * bf
+        # pages_for_budget spends one row of the budget on the scratch
+        # page, same contract as the float pools.
+        assert (kv_cache.pages_for_budget(plan, page_size=8,
+                                          budget_bytes=budget,
+                                          dtype=jnp.int8)
+                == budget // i8 - 1)
+
+    def test_contiguous_cache_rejects_int8(self):
+        plan = self._plan64()
+        with pytest.raises(ValueError, match="int8"):
+            kv_cache.init_cache(plan, max_batch=2, max_len=16,
+                                dtype=jnp.int8)
+
+    def test_engine_rejects_kv_dtype_without_paged(self):
+        model = _lm()
+        with pytest.raises(ValueError, match="paged"):
+            ServeEngine(model, max_batch=2, max_len=64, kv_dtype="int8")
+
+    def test_engine_rejects_unknown_kv_dtype(self):
+        model = _lm()
+        with pytest.raises(ValueError, match="int8"):
+            ServeEngine(model, max_batch=2, max_len=64, paged=True,
+                        page_size=8, kv_dtype="int4")
+
+    def test_int8_pool_has_scale_planes_sized_like_pages(self):
+        plan = self._plan64()
+        pool = kv_cache.init_page_pool(plan, num_pages=4, page_size=8,
+                                       dtype=jnp.int8)
+        assert pool["k"].dtype == jnp.int8
+        assert pool["k_scale"].dtype == jnp.float32
+        assert pool["k_scale"].shape == pool["k"].shape[:-1]
+        assert pool["v_scale"].shape == pool["v"].shape[:-1]
+
+    def test_int8_streams_match_fp32_paged(self):
+        model = _lm()
+        workload = _workload(12)
+        want = _drive(ServeEngine(model, max_batch=4, max_len=64,
+                                  paged=True, page_size=8), workload)
+        got = _drive(ServeEngine(model, max_batch=4, max_len=64,
+                                 paged=True, page_size=8,
+                                 kv_dtype="int8"), workload)
+        assert got == want
+
+    def test_copy_page_carries_scale_rows(self):
+        plan = self._plan64()
+        pool = kv_cache.init_page_pool(plan, num_pages=4, page_size=8,
+                                       dtype=jnp.int8)
+        pool = dict(pool)
+        for name in pool:
+            marked = np.array(pool[name])
+            marked[:, 0] = 7
+            pool[name] = jnp.asarray(marked)
+        pool = kv_cache.copy_page(pool, src=0, dst=2)
+        for name in ("k", "v", "k_scale", "v_scale"):
+            np.testing.assert_array_equal(np.asarray(pool[name][:, 2]),
+                                          np.asarray(pool[name][:, 0]))
+
+    def test_prefix_hit_cow_streams_match_cold_int8(self):
+        """The int8 COW path must copy payload AND scale rows: a warm
+        prefix-cache engine has to emit exactly what a cache-free int8
+        engine emits across tail-sharing divergent suffixes."""
+        model = _lm()
+        pre = np.random.default_rng(2).integers(
+            1, VOCAB, size=21).tolist()  # 2 full pages + partial tail
+        warm = ServeEngine(model, max_batch=4, max_len=64, paged=True,
+                           page_size=8, kv_dtype="int8")
+        cold = ServeEngine(model, max_batch=4, max_len=64, paged=True,
+                           page_size=8, prefix_caching=False,
+                           kv_dtype="int8")
+        for sfx in ([7, 9], [7, 3], [2]):
+            assert (warm.generate(pre + sfx, max_new_tokens=6)
+                    == cold.generate(pre + sfx, max_new_tokens=6)), sfx
+        assert warm._paging.prefix.hits >= 2
+        warm._paging.allocator.check()
+
+    def test_quant_error_metric_recorded(self):
+        model = _lm()
+        registry = metrics.get_registry()
+        registry.reset()
+        metrics.enable()
+        try:
+            engine = ServeEngine(model, max_batch=2, max_len=64,
+                                 paged=True, page_size=8,
+                                 kv_dtype="int8")
+            engine.generate(list(range(1, 15)), max_new_tokens=4)
+            dist = registry.distribution("serve.kv.quant_error")
+            gauge = registry.gauge("serve.pages.bytes_per_slot")
+            assert dist.count >= 1
+            # Per-position amax scaling keeps the dequant error tiny
+            # relative to these O(1) activations.
+            assert 0 <= dist.max < 0.5
+            assert gauge.value > 0
+        finally:
+            metrics.disable()
+
+
+class TestRaggedDecode:
+    def test_ragged_requires_paged(self):
+        model = _lm()
+        with pytest.raises(ValueError, match="paged"):
+            ServeEngine(model, max_batch=2, max_len=64, ragged=True)
+
+    def test_ragged_streams_match_bucketed(self):
+        model = _lm()
+        workload = _workload(12)
+        want = _drive(ServeEngine(model, max_batch=4, max_len=64,
+                                  paged=True, page_size=8), workload)
+        got = _drive(ServeEngine(model, max_batch=4, max_len=64,
+                                 paged=True, page_size=8, ragged=True),
+                     workload)
+        assert got == want
+
+    def test_ragged_int8_streams_match_bucketed_int8(self):
+        model = _lm()
+        workload = _workload(10)
+        want = _drive(ServeEngine(model, max_batch=4, max_len=64,
+                                  paged=True, page_size=8,
+                                  kv_dtype="int8"), workload)
+        got = _drive(ServeEngine(model, max_batch=4, max_len=64,
+                                 paged=True, page_size=8, ragged=True,
+                                 kv_dtype="int8"), workload)
+        assert got == want
+
+    def test_single_program_no_steady_state_retrace(self):
+        """The pow2-retrace kill shot: ONE decode program at full
+        capacity, and its jit cache must sit at exactly one entry even
+        after a second backlog churns through every occupancy level."""
+        model = _lm()
+        engine = ServeEngine(model, max_batch=4, max_len=64, paged=True,
+                             page_size=8, ragged=True)
+        _drive(engine, _workload(12))
+        assert engine.compiled_programs()["paged_decode"] == [4]
+        fn = engine._paged_decode_fns[4]
+        assert fn._cache_size() == 1
+        _drive(engine, _workload(8, seed=11))
+        assert engine.compiled_programs()["paged_decode"] == [4]
+        assert fn._cache_size() == 1

@@ -446,6 +446,55 @@ class TestCrashRecoveryParity:
                 f"request {rid} diverged after paged recovery: "
                 f"{jr.tokens} != {want}")
 
+    def test_int8_ragged_recovery_streams_match_uninterrupted(
+            self, tmp_path):
+        """Journal replay × int8 quantized pages: the journal stores
+        prompts + emitted tokens, never pool bytes — replay re-runs the
+        prefill and re-QUANTIZES every page from scratch. Per-position
+        amax scaling makes each position's int8 bytes a pure function of
+        that position's K/V, independent of write order or batch
+        composition, so the revived engine's streams are bit-identical
+        by construction. Ragged decode rides along: replay admission
+        lands requests in different slots than the first life, and the
+        active-mask routing must not care."""
+        model = _lm()
+        workload = _workload(8)
+        paged_kw = dict(paged=True, page_size=8, kv_dtype="int8",
+                        ragged=True)
+        baseline_engine = ServeEngine(model, max_batch=4, max_len=32,
+                                      **paged_kw)
+        reqs = [baseline_engine.submit(
+            w["prompt"], max_new_tokens=w["max_new_tokens"])
+            for w in workload]
+        baseline_engine.run_until_idle()
+        baseline = {r.rid: list(r.generated) for r in reqs}
+
+        first = ServeEngine(model, max_batch=4, max_len=32,
+                            journal=tmp_path / "j", **paged_kw)
+        for w in workload:
+            first.submit(w["prompt"], max_new_tokens=w["max_new_tokens"])
+        for _ in range(3):
+            first.step()
+        first.journal._buf.clear()  # the torn unflushed tail
+        del first
+
+        second = ServeEngine(model, max_batch=4, max_len=32,
+                             journal=tmp_path / "j", **paged_kw)
+        assert second.last_replay is not None
+        assert second.known_rids == set(range(8))
+        second.run_until_idle()
+        second._paging.allocator.check()
+        second.close()
+
+        state = journal_lib.load(tmp_path / "j" / journal_lib.JOURNAL_NAME)
+        assert len(state.replay_markers) == 1
+        for rid, want in baseline.items():
+            jr = state.requests[rid]
+            assert jr.finished, f"request {rid} never finished after replay"
+            assert jr.tokens == want, (
+                f"request {rid} diverged after int8 recovery: "
+                f"{jr.tokens} != {want}")
+
     def test_stop_satisfied_requests_finish_during_replay(self, tmp_path):
         j = RequestJournal(tmp_path / "j", fsync=False)
         done = Request(prompt=[1, 2], max_new_tokens=2, rid=0)

@@ -789,6 +789,103 @@ def _trace_serve_paged_prefill_chunk():
         params, pool, page_row, tokens)
 
 
+def _trace_serve_paged_decode_ragged():
+    """``serve.kv_cache.paged_decode_ragged`` — the single full-capacity
+    decode program that replaces the pow2-bucket family: per-slot active
+    masking routes inactive rows' tail writes to the scratch page and
+    attention masks by length. Pinned separately from the bucketed step
+    so the retrace-surface collapse stays honest: ONE program, the same
+    collective-free/RNG-free contract, and an HBM baseline that catches
+    an accidental pool-sized temporary exactly like the bucketed pin."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, _ = _serve_probe()
+    pool = kv_cache.init_page_pool(plan, num_pages=8, page_size=4)
+    tables = jnp.zeros((4, 4), jnp.int32)
+    tokens = jnp.zeros((4,), jnp.int32)
+    lengths = jnp.ones((4,), jnp.int32)
+    active = jnp.ones((4,), bool)
+    return jax.make_jaxpr(
+        lambda p, c, tb, t, ln, a: kv_cache.paged_decode_ragged(
+            plan, p, c, tb, t, ln, a))(
+        params, pool, tables, tokens, lengths, active)
+
+
+def _trace_serve_paged_prefill_int8():
+    """``serve.kv_cache.paged_prefill`` over an int8 pool — quantize-on-
+    write (per-position amax scales into the fp32 scale rows) with
+    dequant fused into the page gather, plus the max-abs quant-error
+    reduction the engine reads back host-side. Pinned separately from
+    the float pin so the quantized path carries its own collective-free
+    / RNG-free contract and HBM budget (the int8 payload plus scale rows
+    must price BELOW the float pool, and the error reduction must not
+    smuggle in a host callback)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, _ = _serve_probe()
+    pool = kv_cache.init_page_pool(plan, num_pages=8, page_size=4,
+                                   dtype=jnp.int8)
+    page_row = jnp.zeros((4,), jnp.int32)
+    tokens = jnp.zeros((8,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, r, t: kv_cache.paged_prefill(
+            plan, p, c, r, t, jnp.int32(5), jnp.int32(0)))(
+        params, pool, page_row, tokens)
+
+
+def _trace_serve_paged_decode_int8():
+    """``serve.kv_cache.paged_decode_step`` over an int8 pool — the
+    quantized serving hot loop: int8 tail-page scatter + scale-row write,
+    dequantizing gather, fp32 softmax. Same collective-free contract as
+    the float pin; the separate HBM baseline is the capacity claim made
+    auditable (the gathered working set shrinks with the payload)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, _ = _serve_probe()
+    pool = kv_cache.init_page_pool(plan, num_pages=8, page_size=4,
+                                   dtype=jnp.int8)
+    tables = jnp.zeros((4, 4), jnp.int32)
+    tokens = jnp.zeros((4,), jnp.int32)
+    lengths = jnp.ones((4,), jnp.int32)
+    return jax.make_jaxpr(
+        lambda p, c, tb, t, ln: kv_cache.paged_decode_step(
+            plan, p, c, tb, t, ln, bucket=4))(
+        params, pool, tables, tokens, lengths)
+
+
+def _trace_serve_paged_decode_ragged_int8():
+    """``serve.kv_cache.paged_decode_ragged`` over an int8 pool — the
+    two tentpole optimizations composed: one full-capacity masked decode
+    program over quantized pages. The production configuration for
+    capacity-bound serving, so it gets its own pin rather than trusting
+    the features to compose silently."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_dist.serve import kv_cache
+
+    plan, params, _ = _serve_probe()
+    pool = kv_cache.init_page_pool(plan, num_pages=8, page_size=4,
+                                   dtype=jnp.int8)
+    tables = jnp.zeros((4, 4), jnp.int32)
+    tokens = jnp.zeros((4,), jnp.int32)
+    lengths = jnp.ones((4,), jnp.int32)
+    active = jnp.ones((4,), bool)
+    return jax.make_jaxpr(
+        lambda p, c, tb, t, ln, a: kv_cache.paged_decode_ragged(
+            plan, p, c, tb, t, ln, a))(
+        params, pool, tables, tokens, lengths, active)
+
+
 def _trace_integrity_health_step():
     """The trainer step WITH the in-step health vector — same program the
     plain train_step entry traces (health_summary is always folded in), but
@@ -1008,6 +1105,10 @@ ENTRY_POINTS = {
     "serve.paged_decode_step": _trace_serve_paged_decode,
     "serve.prefill_chunk_step": _trace_serve_prefill_chunk,
     "serve.paged_prefill_chunk": _trace_serve_paged_prefill_chunk,
+    "serve.paged_decode_ragged": _trace_serve_paged_decode_ragged,
+    "serve.paged_prefill_int8": _trace_serve_paged_prefill_int8,
+    "serve.paged_decode_int8": _trace_serve_paged_decode_int8,
+    "serve.paged_decode_ragged_int8": _trace_serve_paged_decode_ragged_int8,
     "training.integrity.health_step": _trace_integrity_health_step,
     "training.integrity.audit_checksum": _trace_integrity_audit_checksum,
     "training.integrity.audit_checksum_sharded":

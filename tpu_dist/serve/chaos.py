@@ -151,7 +151,7 @@ def run_storm(args, *, shedding: bool, target_s: float) -> dict:
     bursts between decode rounds, latency measured on the virtual clock.
     ``shedding`` arms the bounded queue + projected-TTFT bound; the
     control run takes the full storm and eats the queueing delay."""
-    from tpu_dist.serve.cli import _build_engine
+    from tpu_dist.serve.cli import _build_engine, _quantile
 
     clock = VirtualClock()
     max_queue = (args.max_queue if args.max_queue is not None
@@ -181,7 +181,7 @@ def run_storm(args, *, shedding: bool, target_s: float) -> dict:
     done = [r for r in engine.finished if r.status == DONE]
     shed = [r for r in engine.finished if r.status == SHED]
     lat = [r.latency_s for r in done if r.latency_s is not None]
-    p99 = round(float(np.quantile(lat, 0.99)), 6) if lat else None
+    p99 = _quantile(lat, 0.99)
     return {
         "mode": "shedding" if shedding else "control",
         "requests": n,

@@ -37,6 +37,16 @@ from tpu_dist.observe import metrics
 from tpu_dist.observe.telemetry import OBSERVE_DIR_ENV
 
 
+def _quantile(vals, p: float) -> Optional[float]:
+    """Shared report-quantile helper (bench summary + chaos storm gate):
+    None on empty, else the :func:`tpu_dist.observe.metrics.quantile`
+    linear-interpolation estimator — the same math the metrics snapshot
+    quotes, so every serve report agrees on the estimator."""
+    if not vals:
+        return None
+    return round(metrics.quantile(sorted(float(v) for v in vals), p), 6)
+
+
 def _build_engine(args, *, policy: Optional[str] = None, **engine_kwargs):
     """Build the demo/bench engine; ``engine_kwargs`` forward the
     resilience knobs (journal, max_queue, stall watchdog, ...) straight to
@@ -48,6 +58,10 @@ def _build_engine(args, *, policy: Optional[str] = None, **engine_kwargs):
     if getattr(args, "paged", False):
         paged_kwargs = {"paged": True, "page_size": args.page_size,
                         "num_pages": args.num_pages}
+        if getattr(args, "kv_dtype", None) is not None:
+            paged_kwargs["kv_dtype"] = args.kv_dtype
+        if getattr(args, "ragged", False):
+            paged_kwargs["ragged"] = True
     if getattr(args, "budget_mb", None) is not None:
         paged_kwargs["budget_bytes"] = int(args.budget_mb * 2**20)
     if args.model_dir:
@@ -92,9 +106,7 @@ def _summary(engine, *, wall_s: float) -> dict:
         "finished request with a non-terminal status"
     tokens = sum(len(r.generated) for r in engine.finished)
 
-    def q(vals, p):
-        return round(float(np.quantile(vals, p)), 6) if vals else None
-
+    q = _quantile
     lat = [r.latency_s for r in done if r.latency_s is not None]
     ttft = [r.ttft_s for r in done if r.ttft_s is not None]
     snap = metrics.get_registry().snapshot() if metrics.enabled() else None
@@ -229,6 +241,14 @@ def main(argv=None) -> int:
     p.add_argument("--budget-mb", type=float, default=None,
                    help="KV memory budget in MiB — loud sizing error "
                         "(contiguous) or pool auto-sizing (--paged)")
+    p.add_argument("--kv-dtype", choices=("fp32", "bf16", "int8"),
+                   default=None,
+                   help="paged-pool storage dtype (with --paged); int8 "
+                        "quantizes K/V pages with per-position fp32 "
+                        "scales — ~2x pages at a fixed --budget-mb")
+    p.add_argument("--ragged", action="store_true",
+                   help="one full-capacity decode program with per-slot "
+                        "masking instead of pow2 buckets (with --paged)")
     # -- resilience / chaos (README "Serving resilience") -----------------
     p.add_argument("--worker", action="store_true",
                    help="supervised serve worker: journal + fault plan "
@@ -318,6 +338,8 @@ def main(argv=None) -> int:
                            "arrival_rate": args.arrival_rate,
                            "paged": bool(args.paged),
                            "page_size": args.page_size,
+                           "kv_dtype": args.kv_dtype,
+                           "ragged": bool(args.ragged),
                            "seed": args.seed},
                 **summary,
             }

@@ -11,7 +11,8 @@ else — scheduling stays host-side in ``scheduler.py``, math stays in
   default_buckets``): requests come and go between steps, the active
   count maps to the smallest covering bucket, and steady-state serving
   never retraces — the same no-retrace discipline ``Trainer.predict``
-  now follows;
+  now follows. ``ragged=True`` (paged only) collapses the family to a
+  single full-capacity program with a per-slot active mask;
 * one **slot-swap** program (traced slot indices) mirroring the
   scheduler's compaction moves into the KV cache.
 
@@ -195,6 +196,24 @@ class ServeEngine:
         decode steps (default 1 — the flattest-latency policy). Chunks
         drain arrival-ordered (the head request finishes before a later
         one starts), so chunked prefill cannot starve anyone.
+      kv_dtype: paged-pool storage dtype — ``"fp32"``/``"bf16"``/
+        ``"int8"`` (or the jnp dtypes). ``"int8"`` stores K/V pages as
+        int8 with per-position fp32 scale rows: a fixed ``budget_bytes``
+        buys ~2x the pages (gate: >= 1.8x concurrent slots in
+        serve-bench), greedy streams stay token-identical on short
+        horizons and logit drift stays bounded on long ones
+        (quantization is write-order independent, so chunked prefill,
+        COW, and journal replay all reproduce exact pool bytes). Paged
+        mode only — the contiguous cache keeps ``cache_dtype``. Default
+        None: the pool dtype is ``cache_dtype``, programs byte-unchanged.
+      ragged: paged mode only — decode ALL slots in one full-capacity
+        program with a per-slot active mask instead of the pow2-bucket
+        program family. The page-table gather already erased contiguity,
+        so bucketing is pure retrace surface: ragged engines compile
+        exactly ONE decode program and stream token-identically to
+        bucketed ones (tests + serve-bench pin both). Default False:
+        the bucketed family remains (it is the contiguous engine's only
+        mode and the bench's A/B control).
     """
 
     def __init__(self, model: Sequential, *, max_batch: int = 8,
@@ -210,7 +229,8 @@ class ServeEngine:
                  page_size: int = 16, num_pages: Optional[int] = None,
                  budget_bytes: Optional[int] = None,
                  prefix_caching: bool = True, prefill_chunk: int = 0,
-                 prefill_interleave: int = 1):
+                 prefill_interleave: int = 1, kv_dtype=None,
+                 ragged: bool = False):
         self.model = model
         self.plan = kv_cache.build_plan(model)
         self.max_len = int(max_len or self.plan.max_position)
@@ -257,6 +277,28 @@ class ServeEngine:
         self.params = self.strategy.replicate(params)
         self.paged = bool(paged)
         self.page_size = int(page_size)
+        self.ragged = bool(ragged)
+        if self.ragged and not self.paged:
+            raise ValueError(
+                "serve: ragged decode rides the page tables (one full-"
+                "capacity program, per-slot masking) — pass paged=True")
+        if kv_dtype is not None:
+            if not self.paged:
+                raise ValueError(
+                    "serve: kv_dtype is a paged-pool knob — pass "
+                    "paged=True (the contiguous cache keeps cache_dtype)")
+            aliases = {"fp32": jnp.float32, "bf16": jnp.bfloat16}
+            resolved = (aliases.get(kv_dtype, kv_dtype)
+                        if isinstance(kv_dtype, str) else kv_dtype)
+            dt = jnp.dtype(resolved)
+            if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+                          jnp.dtype(jnp.int8)):
+                raise ValueError(
+                    f"serve: kv_dtype must be one of fp32/bf16/int8, "
+                    f"got {kv_dtype!r}")
+            cache_dtype = resolved
+        self._kv_quant = (self.paged
+                          and jnp.dtype(cache_dtype) == jnp.int8)
         if self.paged:
             max_pages = -(-self.max_len // self.page_size)
             if num_pages is None and budget_bytes is not None:
@@ -275,21 +317,28 @@ class ServeEngine:
                 self.plan, num_pages=self.num_pages,
                 page_size=self.page_size, dtype=cache_dtype,
                 budget_bytes=budget_bytes))
-            per_token = (2 * self.plan.num_layers * self.plan.num_heads
-                         * self.plan.key_dim
-                         * jnp.dtype(cache_dtype).itemsize)
+            # Per-position pool bytes, derived from the page layout so
+            # int8's fp32 scale rows are priced in (for float dtypes this
+            # is exactly 2 * L * H * dk * itemsize).
+            per_token = kv_cache.page_nbytes(
+                self.plan, page_size=self.page_size,
+                dtype=cache_dtype) // self.page_size
             self._paging = paging.PagedKVState(
                 num_pages=self.num_pages, page_size=self.page_size,
                 slots=self.max_batch, max_pages=max_pages,
                 bytes_per_token=per_token, prefix_caching=prefix_caching)
             logger.info(
                 "serve: paged — %d slots, %d pages x %d positions "
-                "(+scratch), pool %.1f MiB, prefix caching %s, buckets %s",
+                "(+scratch), pool %.1f MiB (%s), prefix caching %s, "
+                "decode %s",
                 self.max_batch, self.num_pages, self.page_size,
                 kv_cache.page_pool_nbytes(
                     self.plan, num_pages=self.num_pages,
                     page_size=self.page_size, dtype=cache_dtype) / 2**20,
-                "on" if prefix_caching else "off", buckets or "pow2")
+                jnp.dtype(cache_dtype).name,
+                "on" if prefix_caching else "off",
+                "ragged" if self.ragged else
+                f"buckets {buckets or 'pow2'}")
         else:
             self._paging = None
             self.cache = self.strategy.replicate(kv_cache.init_cache(
@@ -476,12 +525,23 @@ class ServeEngine:
     def _paged_decode_fn(self, bucket: int):
         fn = self._paged_decode_fns.get(bucket)
         if fn is None:
-            fn = self._acquire_program(
-                "paged_decode", bucket,
-                lambda: jax.jit(
-                    functools.partial(kv_cache.paged_decode_step, self.plan,
-                                      bucket=bucket),
-                    donate_argnums=self._donate))
+            if self.ragged:
+                # One full-capacity program; ``bucket`` is always
+                # max_batch here, kept as the cache key so
+                # compiled_programs() reports the surface uniformly.
+                fn = self._acquire_program(
+                    "paged_decode_ragged", bucket,
+                    lambda: jax.jit(
+                        functools.partial(kv_cache.paged_decode_ragged,
+                                          self.plan),
+                        donate_argnums=self._donate))
+            else:
+                fn = self._acquire_program(
+                    "paged_decode", bucket,
+                    lambda: jax.jit(
+                        functools.partial(kv_cache.paged_decode_step,
+                                          self.plan, bucket=bucket),
+                        donate_argnums=self._donate))
             self._paged_decode_fns[bucket] = fn
         return fn
 
@@ -517,7 +577,9 @@ class ServeEngine:
         Contiguous chunked engines add ``prefill_chunk``: one program per
         pow2 chunk pad (paged chunked engines run chunks through the
         ``paged_prefill`` surface — same traced-start programs). The
-        default ``prefill_chunk=0`` leaves the dict bit-unchanged."""
+        default ``prefill_chunk=0`` leaves the dict bit-unchanged. Ragged
+        paged engines report ``paged_decode == [max_batch]`` — exactly
+        one full-capacity decode program, ever (tests pin it)."""
         out = {"decode": sorted(self._decode_fns),
                "prefill": sorted(self._prefill_fns)}
         if self.paged:
@@ -738,6 +800,19 @@ class ServeEngine:
         clones) deadlock-free."""
         return self._paging.try_admit(self._total_tokens(req))
 
+    def _unpack_prefill(self, out):
+        """Unpack a paged-prefill result: int8 pools return a third
+        element — the call's max-abs dequantization error — observed
+        host-side into the ``serve.kv.quant_error`` distribution (the
+        readback happens after the traced program, so shardcheck's
+        SC103 host-callback scan stays clean)."""
+        if self._kv_quant:
+            self.cache, logits, qerr = out
+            metrics.observe_value("serve.kv.quant_error", float(qerr))
+        else:
+            self.cache, logits = out
+        return logits
+
     def _prefill(self, req: Request) -> None:
         # A journal-recovered request re-prefills with prompt + everything
         # it had already generated: the incremental-decode ≡ full-forward
@@ -763,10 +838,10 @@ class ServeEngine:
             tokens[:suffix] = seq[setup.start:]
             fn = self._paged_prefill_fn(pad)
             row = self._paging.allocator.table[req.slot]
-            self.cache, logits = fn(self.params, self.cache,
-                                    jnp.asarray(row), jnp.asarray(tokens),
-                                    jnp.int32(plen),
-                                    jnp.int32(setup.start))
+            out = fn(self.params, self.cache,
+                     jnp.asarray(row), jnp.asarray(tokens),
+                     jnp.int32(plen), jnp.int32(setup.start))
+            logits = self._unpack_prefill(out)
             self._paging.register_prefill(req.slot, req.prompt)
         else:
             pad = _pad_to_pow2(plen, hi=self.max_len)
@@ -835,9 +910,10 @@ class ServeEngine:
             self._paging.extend_prefill(req.slot, end)
             fn = self._paged_prefill_fn(pad)
             row = self._paging.allocator.table[req.slot]
-            self.cache, logits = fn(self.params, self.cache,
-                                    jnp.asarray(row), jnp.asarray(tokens),
-                                    jnp.int32(end), jnp.int32(startpos))
+            out = fn(self.params, self.cache,
+                     jnp.asarray(row), jnp.asarray(tokens),
+                     jnp.int32(end), jnp.int32(startpos))
+            logits = self._unpack_prefill(out)
         else:
             fn = self._chunk_fn(pad)
             self.cache, logits = fn(self.params, self.cache,
@@ -912,7 +988,11 @@ class ServeEngine:
             if self.journal is not None:
                 self.journal.flush()
             return n
-        bucket = self.scheduler.bucket()
+        # Ragged mode decodes the whole slot capacity in one program —
+        # the scheduler's pow2 bucket is never consulted, so occupancy
+        # is measured against true capacity.
+        bucket = (self.max_batch if self.paged and self.ragged
+                  else self.scheduler.bucket())
         metrics.observe_value("serve.batch.occupancy", len(ready) / bucket)
         if self.paged:
             # Host-side page bookkeeping for this round's appends: cross
@@ -934,7 +1014,21 @@ class ServeEngine:
             timer.daemon = True
             timer.start()
         try:
-            if self.paged:
+            if self.paged and self.ragged:
+                # Per-slot active mask: only fully-prefilled decoding
+                # slots write to their real tail pages — empty slots AND
+                # slots mid-chunked-prefill (whose table rows hold real
+                # pages a stray decode write must not touch) route their
+                # garbage write to the scratch page inside the kernel.
+                active = np.zeros(self.max_batch, bool)
+                for req in ready:
+                    active[req.slot] = True
+                self.cache, logits = self._paged_decode_fn(bucket)(
+                    self.params, self.cache,
+                    jnp.asarray(self._paging.allocator.table),
+                    jnp.asarray(self._tokens), jnp.asarray(self._lengths),
+                    jnp.asarray(active))
+            elif self.paged:
                 self.cache, logits = self._paged_decode_fn(bucket)(
                     self.params, self.cache,
                     jnp.asarray(self._paging.allocator.table),

@@ -157,6 +157,11 @@ def init_cache(plan: DecodePlan, *, max_batch: int, max_len: int,
     hard guard: when the cache would not fit, raise a loud error naming
     how many slots DO fit instead of letting XLA OOM at first prefill.
     """
+    if jnp.dtype(dtype) == jnp.int8:
+        raise ValueError(
+            "serve: int8 KV is a paged-pool feature (the quantized pages "
+            "carry per-page scale rows the contiguous cache has no layout "
+            "for) — use ServeEngine(paged=True, kv_dtype='int8')")
     if max_len > plan.max_position:
         raise ValueError(
             f"max_len {max_len} exceeds the model's positional table "
@@ -454,13 +459,55 @@ def swap_slots(cache: dict, i, j):
 # absolute sequence position j, so the contiguous validity mask
 # ``arange <= pos`` carries over unchanged and the paged math stays
 # allclose-equal to the contiguous path (tests pin it).
+#
+# int8 pool (``dtype=jnp.int8``): pages store K/V as int8 with fp32
+# per-page scale ROWS — ``k_scale``/``v_scale`` of ``[num_layers,
+# num_pages + 1, num_heads, page_size]``, one amax-derived symmetric
+# scale per written position per head. Quantization happens at write
+# time (prefill scatter, decode tail-append; ``copy_page`` clones the
+# scale rows along with the int8 payload through the same generic loop)
+# and dequantization is fused into the page gather, so the fp32
+# attention math downstream is byte-for-byte the float path on the
+# dequantized values. Scaling per POSITION rather than per whole page is
+# what makes quantization write-order independent: a position's stored
+# bytes depend only on its own K/V projection — never on what else
+# landed in the page before or after — so journal replay (one big
+# re-prefill) reproduces the exact pool bytes of the crashed run
+# (prefill + many appends), and chunked prefill reproduces whole-prompt
+# prefill, bit for bit. A per-page running-amax scale would break both:
+# every amax bump re-rounds the page's older positions, making the
+# bytes a function of write history.
+
+#: Symmetric int8 range; scale = amax / _QMAX, values in [-127, 127].
+_QMAX = 127.0
+
+
+def _quantized(pool: dict) -> bool:
+    """True for an int8 pool (fp32 scale planes present)."""
+    return "k_scale" in pool
+
+
+def _quant_rows(x):
+    """Quantize ``[..., dk]`` fp rows to (int8 ``[..., dk]``, fp32 scale
+    ``[...]``) — one symmetric amax scale per row. All-zero rows get
+    scale 1 so they round-trip to exact zeros."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / _QMAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(xf / safe[..., None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8), scale
 
 
 def page_nbytes(plan: DecodePlan, *, page_size: int,
                 dtype=jnp.float32) -> int:
-    """HBM one page pins across every layer, k and v."""
+    """HBM one page pins across every layer, k and v. An int8 page also
+    carries its fp32 scale rows (k and v, per head per position)."""
     n = 2 * plan.num_layers * plan.num_heads * page_size * plan.key_dim
-    return n * jnp.dtype(dtype).itemsize
+    dt = jnp.dtype(dtype)
+    if dt == jnp.int8:
+        scales = 2 * plan.num_layers * plan.num_heads * page_size * 4
+        return n * dt.itemsize + scales
+    return n * dt.itemsize
 
 
 def page_pool_nbytes(plan: DecodePlan, *, num_pages: int, page_size: int,
@@ -504,7 +551,15 @@ def init_page_pool(plan: DecodePlan, *, num_pages: int, page_size: int,
                 "page(s). Lower num_pages/page_size or raise the budget.")
     shape = (plan.num_layers, num_pages + 1, plan.num_heads, page_size,
              plan.key_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    pool = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.int8:
+        # fp32 scale rows, one per (layer, page, head, position). Zero
+        # pages decode to exact zeros under any scale; real scales are
+        # written alongside every K/V write.
+        sshape = shape[:-1]
+        pool["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        pool["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return pool
 
 
 def _gather_pages(pool_arr, layer_idx: int, page_rows):
@@ -521,6 +576,19 @@ def _gather_pages(pool_arr, layer_idx: int, page_rows):
     g = jnp.moveaxis(g, -3, -4)            # [..., H, max_pages, ps, dk]
     *lead, h, mp, ps, dk = g.shape
     return g.reshape(*lead, h, mp * ps, dk)
+
+
+def _gather_kv(pool: dict, name: str, layer_idx: int, page_rows):
+    """Position-ordered gather of ``pool[name]``, dequantized for int8
+    pools (int8 payload × per-position fp32 scale row → fp32); float
+    pools pass straight through :func:`_gather_pages`."""
+    g = _gather_pages(pool[name], layer_idx, page_rows)
+    if not _quantized(pool):
+        return g
+    s = pool[name + "_scale"][layer_idx][page_rows]  # [..., mp, H, ps]
+    s = jnp.moveaxis(s, -2, -3)                      # [..., H, mp, ps]
+    *lead, h, mp, ps = s.shape
+    return g.astype(jnp.float32) * s.reshape(*lead, h, mp * ps)[..., None]
 
 
 def paged_prefill(plan: DecodePlan, params, pool: dict, page_row, tokens,
@@ -547,8 +615,10 @@ def paged_prefill(plan: DecodePlan, params, pool: dict, page_row, tokens,
       start: scalar int32 cached-prefix length (``< length``).
 
     Returns:
-      ``(pool, last_logits)`` — logits ``[vocab]`` of position
-      ``length - 1``.
+      ``(pool, last_logits)`` for float pools; int8 pools return
+      ``(pool, last_logits, quant_error)`` where ``quant_error`` is the
+      max-abs dequantization error over this call's valid suffix
+      positions (fp32 scalar — the ``serve.kv.quant_error`` datum).
     """
     num_pages = pool["k"].shape[1] - 1     # last row is scratch
     ps = pool["k"].shape[3]
@@ -559,6 +629,7 @@ def paged_prefill(plan: DecodePlan, params, pool: dict, page_row, tokens,
     pos = start + jnp.arange(pad)          # absolute positions [pad]
     valid_q = jnp.arange(pad) < suffix     # [pad]
     key_pos = jnp.arange(max_pages * ps)
+    qerr = jnp.zeros((), jnp.float32)
     residuals: list = []
     for op in plan.ops:
         tag = op[0]
@@ -575,7 +646,6 @@ def paged_prefill(plan: DecodePlan, params, pool: dict, page_row, tokens,
             _, layer, path, idx = op
             p = _params_at(params, path)
             q, k, v = _qkv(layer, p, x)    # [1, H, pad, dk]
-            dt = pool["k"].dtype
             # Scatter each suffix position into (its page, its offset);
             # padding positions are routed to the scratch page.
             pg = jnp.where(
@@ -583,11 +653,26 @@ def paged_prefill(plan: DecodePlan, params, pool: dict, page_row, tokens,
                 page_row[jnp.minimum(pos // ps, max_pages - 1)],
                 num_pages)                 # [pad]
             off = pos % ps
-            for name, new in (("k", k), ("v", v)):
-                pool[name] = pool[name].at[idx, pg, :, off, :].set(
-                    jnp.moveaxis(new.astype(dt)[0], 1, 0))  # [pad, H, dk]
-            keys = _gather_pages(pool["k"], idx, page_row)  # [H, S, dk]
-            vals = _gather_pages(pool["v"], idx, page_row)
+            if _quantized(pool):
+                for name, new in (("k", k), ("v", v)):
+                    rows = jnp.moveaxis(                     # [pad, H, dk]
+                        new[0].astype(jnp.float32), 1, 0)
+                    qv, sc = _quant_rows(rows)
+                    pool[name] = pool[name].at[idx, pg, :, off, :].set(qv)
+                    pool[name + "_scale"] = \
+                        pool[name + "_scale"].at[idx, pg, :, off].set(sc)
+                    err = jnp.max(jnp.abs(
+                        rows - qv.astype(jnp.float32) * sc[..., None]),
+                        axis=(1, 2))                         # [pad]
+                    qerr = jnp.maximum(
+                        qerr, jnp.max(jnp.where(valid_q, err, 0.0)))
+            else:
+                dt = pool["k"].dtype
+                for name, new in (("k", k), ("v", v)):
+                    pool[name] = pool[name].at[idx, pg, :, off, :].set(
+                        jnp.moveaxis(new.astype(dt)[0], 1, 0))  # [pad, H, dk]
+            keys = _gather_kv(pool, "k", idx, page_row)  # [H, S, dk]
+            vals = _gather_kv(pool, "v", idx, page_row)
             scale = 1.0 / math.sqrt(layer.key_dim)
             s = jnp.einsum("hqd,hkd->hqk", q[0].astype(jnp.float32),
                            keys.astype(jnp.float32)) * scale
@@ -605,33 +690,27 @@ def paged_prefill(plan: DecodePlan, params, pool: dict, page_row, tokens,
     # x: [1, pad, vocab]; last valid suffix position is suffix - 1.
     last = jax.lax.dynamic_slice(
         x, (0, jnp.maximum(suffix - 1, 0), 0), (1, 1, plan.vocab_size))
+    if _quantized(pool):
+        return pool, last[0, 0], qerr
     return pool, last[0, 0]
 
 
-def paged_decode_step(plan: DecodePlan, params, pool: dict, page_tables,
-                      tokens, lengths, *, bucket: int):
-    """One generated token per slot through the page tables.
+def _paged_decode_core(plan: DecodePlan, params, pool: dict, tables,
+                       tokens, pos, route):
+    """Shared body of the bucketed and ragged paged decode programs.
 
-    The new K/V land at offset ``length % page_size`` of the slot's tail
-    page ``page_tables[slot, length // page_size]``; attention then runs
-    over the gathered pages under the same ``arange <= pos`` validity
-    mask as the contiguous path. Inactive slots inside the bucket must
-    have all-scratch table rows so their garbage writes are absorbed.
-
-    Args:
-      page_tables: int32 ``[cap, max_pages]``; only ``[:bucket]`` read.
-      tokens / lengths / bucket: as :func:`decode_step`.
-
-    Returns:
-      ``(pool, logits)`` with logits ``[bucket, vocab]`` fp32.
+    ``route(pg)`` maps each slot's computed tail page to its write
+    destination — identity for the bucketed path (inactive slots there
+    carry all-scratch table rows by host invariant), scratch-for-inactive
+    for the ragged path (where mid-chunked-prefill slots hold REAL pages
+    a stray decode write must not touch).
     """
-    x = tokens[:bucket][:, None]           # [b, 1]
-    pos = lengths[:bucket]                 # [b]
-    tables = page_tables[:bucket]          # [b, max_pages]
     ps = pool["k"].shape[3]
     max_pages = tables.shape[1]
-    rows = jnp.arange(bucket)
+    b = tokens.shape[0]
+    rows = jnp.arange(b)
     key_pos = jnp.arange(max_pages * ps)
+    x = tokens[:, None]                    # [b, 1]
     residuals: list = []
     for op in plan.ops:
         tag = op[0]
@@ -648,18 +727,25 @@ def paged_decode_step(plan: DecodePlan, params, pool: dict, page_tables,
             _, layer, path, idx = op
             p = _params_at(params, path)
             q, k, v = _qkv(layer, p, x)    # [b, H, 1, dk]
-            dt = pool["k"].dtype
-            # Tail-page append: inactive slots' rows are all scratch, so
-            # clamping the page-table column keeps the gather in range
-            # and the write lands on the scratch page.
-            pg = tables[rows, jnp.minimum(pos // ps, max_pages - 1)]  # [b]
+            # Tail-page append: clamping the page-table column keeps the
+            # gather in range; ``route`` decides where garbage writes go.
+            pg = route(
+                tables[rows, jnp.minimum(pos // ps, max_pages - 1)])  # [b]
             off = pos % ps
-            pool["k"] = pool["k"].at[idx, pg, :, off, :].set(
-                k[:, :, 0, :].astype(dt))
-            pool["v"] = pool["v"].at[idx, pg, :, off, :].set(
-                v[:, :, 0, :].astype(dt))
-            keys = _gather_pages(pool["k"], idx, tables)  # [b, H, S, dk]
-            vals = _gather_pages(pool["v"], idx, tables)
+            if _quantized(pool):
+                for name, new in (("k", k), ("v", v)):
+                    qv, sc = _quant_rows(new[:, :, 0, :])  # [b, H, dk]
+                    pool[name] = pool[name].at[idx, pg, :, off, :].set(qv)
+                    pool[name + "_scale"] = \
+                        pool[name + "_scale"].at[idx, pg, :, off].set(sc)
+            else:
+                dt = pool["k"].dtype
+                pool["k"] = pool["k"].at[idx, pg, :, off, :].set(
+                    k[:, :, 0, :].astype(dt))
+                pool["v"] = pool["v"].at[idx, pg, :, off, :].set(
+                    v[:, :, 0, :].astype(dt))
+            keys = _gather_kv(pool, "k", idx, tables)  # [b, H, S, dk]
+            vals = _gather_kv(pool, "v", idx, tables)
             scale = 1.0 / math.sqrt(layer.key_dim)
             s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                            keys.astype(jnp.float32)) * scale
@@ -675,8 +761,61 @@ def paged_decode_step(plan: DecodePlan, params, pool: dict, page_tables,
     return pool, x[:, 0, :].astype(jnp.float32)  # [b, vocab]
 
 
+def paged_decode_step(plan: DecodePlan, params, pool: dict, page_tables,
+                      tokens, lengths, *, bucket: int):
+    """One generated token for the first ``bucket`` slots through the
+    page tables.
+
+    The new K/V land at offset ``length % page_size`` of the slot's tail
+    page ``page_tables[slot, length // page_size]``; attention then runs
+    over the gathered pages under the same ``arange <= pos`` validity
+    mask as the contiguous path. Inactive slots inside the bucket must
+    have all-scratch table rows so their garbage writes are absorbed.
+
+    Args:
+      page_tables: int32 ``[cap, max_pages]``; only ``[:bucket]`` read.
+      tokens / lengths / bucket: as :func:`decode_step`.
+
+    Returns:
+      ``(pool, logits)`` with logits ``[bucket, vocab]`` fp32.
+    """
+    return _paged_decode_core(plan, params, pool, page_tables[:bucket],
+                              tokens[:bucket], lengths[:bucket],
+                              lambda pg: pg)
+
+
+def paged_decode_ragged(plan: DecodePlan, params, pool: dict, page_tables,
+                        tokens, lengths, active):
+    """One generated token for every ACTIVE slot, full capacity in one
+    program.
+
+    The ragged replacement for the pow2-bucket program family: the page-
+    table gather already erased contiguity, so batch size can be the
+    engine's whole slot capacity with per-slot masking — ONE compiled
+    decode program, zero steady-state retrace. Inactive rows (empty
+    slots, slots mid-chunked-prefill whose table rows hold REAL pages)
+    have their tail writes routed to the scratch page and their logits
+    are garbage the host never reads; active rows compute exactly what
+    :func:`paged_decode_step` computes for them, so ragged and bucketed
+    streams are token-identical (tests pin it).
+
+    Args:
+      page_tables: int32 ``[cap, max_pages]``.
+      tokens / lengths: int32 ``[cap]``, all rows read, inactive ignored.
+      active: bool ``[cap]`` — which slots are really decoding.
+
+    Returns:
+      ``(pool, logits)`` with logits ``[cap, vocab]`` fp32.
+    """
+    num_pages = pool["k"].shape[1] - 1     # last row is scratch
+    return _paged_decode_core(plan, params, pool, page_tables, tokens,
+                              lengths,
+                              lambda pg: jnp.where(active, pg, num_pages))
+
+
 def copy_page(pool: dict, src, dst):
-    """Copy page row ``src`` over ``dst`` (every layer, k and v) — the
+    """Copy page row ``src`` over ``dst`` (every layer, k and v — and,
+    for int8 pools, the fp32 scale rows riding in the same pytree) — the
     device half of copy-on-write: the allocator clones a shared
     prefix-cache page into a private one the moment a request needs to
     write into it. ``src``/``dst`` are traced scalars: one compiled

@@ -620,3 +620,14 @@ class PagedKVState:
                           float(self.allocator.pages_in_use))
         metrics.set_gauge("serve.pages.free",
                           float(self.allocator.free_pages))
+        # Pool bytes actually held per occupied slot (page-table
+        # references x page bytes — shared prefix pages count once per
+        # referencing slot on purpose: this is the capacity-planning
+        # "what does one more request cost" number, and with an int8
+        # pool it is roughly half the float figure at equal lengths).
+        held = int(self.allocator.count.sum())
+        occupied = int(np.count_nonzero(self.allocator.count))
+        if occupied:
+            per_page = self.allocator.page_size * self._bytes_per_token
+            metrics.set_gauge("serve.pages.bytes_per_slot",
+                              held * per_page / occupied)
